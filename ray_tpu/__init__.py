@@ -1,0 +1,55 @@
+"""ray_tpu — a TPU-native distributed ML framework.
+
+A ground-up re-design of Ray (reference: /root/reference) for JAX/XLA on TPU:
+the core task/actor/object API and control plane live here; the ML libraries
+(train/tune/data/serve/rllib) are built purely on this public API, preserving
+the reference's single most important layering rule (SURVEY.md §overview).
+"""
+
+from ray_tpu import exceptions
+from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    get_tpu_ids,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "get_tpu_ids",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

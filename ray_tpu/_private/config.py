@@ -1,0 +1,71 @@
+"""Flag system — single source of truth for runtime tunables.
+
+Mirrors the reference's `RAY_CONFIG(type, name, default)` registry
+(src/ray/common/ray_config_def.h) including env-var override: every flag can be
+overridden with env `RAY_TPU_<NAME>`, and `init(_system_config={...})` overrides
+both.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+
+
+def _env_override(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # Scheduling (reference: hybrid policy, ray_config_def.h:193 spread threshold)
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    max_pending_lease_requests: int = 10
+    # Objects: args larger than this are implicitly put in the store rather than
+    # inlined in the task spec (ray_config_def.h:213 max_direct_call_object_size).
+    max_direct_call_object_size: int = 100 * 1024
+    # Memory cap for the host object store (0 = derive from system memory; the
+    # reference defaults to 30% of RAM with a 200GB cap, ray_constants.py:51-53).
+    object_store_memory: int = 0
+    object_store_memory_fraction: float = 0.3
+    object_store_memory_cap: int = 200 * 1024**3
+    # Fault tolerance
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    # Copy (serialize/deserialize) task args even in the in-process engine so
+    # mutation bugs surface in tests; direct zero-copy handoff when False.
+    inproc_copy_args: bool = False
+    # Worker pool
+    prestart_workers: bool = True
+    idle_worker_killing_time_s: float = 60.0
+    # Logging
+    log_to_driver: bool = True
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_overrides(self, overrides: dict | None):
+        if not overrides:
+            return self
+        valid = {f.name for f in fields(self)}
+        for key, value in overrides.items():
+            if key not in valid:
+                raise ValueError(f"Unknown _system_config key: {key!r}")
+            setattr(self, key, value)
+        return self
+
+
+GLOBAL_CONFIG = Config()

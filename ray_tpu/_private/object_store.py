@@ -1,0 +1,253 @@
+"""Host object store: immutable objects keyed by ObjectID.
+
+The reference splits objects between a per-worker in-process memory store (small
+objects / error signals) and the node-wide plasma shared-memory store
+(src/ray/object_manager/plasma/, embedded in the raylet). This module provides the
+same interface against a single in-process table — the engine used by the threaded
+runtime and tests. The shared-memory (cross-process) store plugs in behind the
+same `StoreInterface`.
+
+Semantics preserved from plasma (object_store.h / object_lifecycle_manager.h):
+  * objects are create-once, sealed, then immutable;
+  * readers block until seal (`get` with timeout);
+  * delete is initiated by the owner's reference counter, never by readers;
+  * memory accounting with a budget; sealing beyond the budget evicts
+    unreferenced objects LRU-first, else raises OutOfMemoryError (the reference
+    instead spills to external storage — spilling is a later milestone).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
+
+
+class OutOfMemoryError(MemoryError):
+    pass
+
+
+def _sizeof(value: Any) -> int:
+    """Approximate in-memory footprint; exact for numpy/bytes, best-effort else."""
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value.nbytes
+    except ImportError:
+        pass
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    return sys.getsizeof(value)
+
+
+class _Entry:
+    __slots__ = ("value", "size", "sealed", "event", "freed", "last_access", "callbacks")
+
+    def __init__(self):
+        self.value = None
+        self.size = 0
+        self.sealed = False
+        self.freed = False
+        self.event = threading.Event()
+        self.last_access = 0.0
+        self.callbacks: list[Callable[[], None]] = []
+
+
+class InProcessStore:
+    """Thread-safe in-process object table with plasma-like lifecycle."""
+
+    def __init__(self, memory_budget: int | None = None):
+        self._lock = threading.Lock()
+        self._entries: dict[ObjectID, _Entry] = {}
+        self._budget = memory_budget
+        self._used = 0
+        # Objects the reference counter still holds references to may not be
+        # evicted; the runtime installs this callback.
+        self._pinned_check: Callable[[ObjectID], bool] = lambda oid: True
+
+    def set_pinned_check(self, fn: Callable[[ObjectID], bool]) -> None:
+        self._pinned_check = fn
+
+    # -- write path ---------------------------------------------------------
+
+    def seal(self, object_id: ObjectID, value: Any) -> None:
+        """Create-and-seal in one step (the in-process store has no partial create)."""
+        size = _sizeof(value)
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            if entry.sealed:
+                # Idempotent reseal happens on task retry; keep first value.
+                return
+            if self._budget is not None and self._used + size > self._budget:
+                self._evict_locked(self._used + size - self._budget)
+            entry.value = value
+            entry.size = size
+            entry.sealed = True
+            entry.freed = False
+            entry.last_access = time.monotonic()
+            self._used += size
+            entry.event.set()
+            callbacks, entry.callbacks = entry.callbacks, []
+        for cb in callbacks:
+            cb()
+
+    def on_sealed(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
+        """Invoke `callback` once the object is sealed (immediately if already).
+
+        This is the in-process analog of the raylet DependencyManager's
+        object-local notifications (raylet/dependency_manager.h).
+        """
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            if not entry.sealed and not entry.freed:
+                entry.callbacks.append(callback)
+                return
+        callback()
+
+    # -- read path ----------------------------------------------------------
+
+    def get(self, object_id: ObjectID, timeout: float | None = None) -> Any:
+        entry = self._wait_entry(object_id, timeout)
+        with self._lock:
+            if entry.freed:
+                raise ObjectFreedError(object_id, f"Object {object_id} was freed")
+            entry.last_access = time.monotonic()
+            return entry.value
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.sealed and not entry.freed
+
+    def wait(
+        self,
+        object_ids: Iterable[ObjectID],
+        num_returns: int,
+        timeout: float | None = None,
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        """Block until `num_returns` of `object_ids` are sealed (ray.wait)."""
+        object_ids = list(object_ids)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[ObjectID] = []
+        remaining: list[ObjectID] = []
+        pending = list(object_ids)
+        while True:
+            still = []
+            for oid in pending:
+                if self.contains(oid) or self._is_freed(oid):
+                    ready.append(oid)
+                else:
+                    still.append(oid)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            wait_for = 0.05
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                wait_for = min(wait_for, left)
+            # Block on the first pending object's event (cheap wakeup heuristic).
+            entry = self._ensure_entry(pending[0])
+            entry.event.wait(wait_for)
+        # First num_returns ready objects; everything else (including surplus
+        # ready ones) stays in `remaining`, preserving input order.
+        taken = set(ready[:num_returns])
+        remaining = [oid for oid in object_ids if oid not in taken]
+        return ready[:num_returns], remaining
+
+    # -- delete path --------------------------------------------------------
+
+    def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.pop(oid, None)
+                if entry is not None and entry.sealed:
+                    self._used -= entry.size
+
+    def free(self, object_ids: Iterable[ObjectID]) -> None:
+        """Mark freed: later `get`s raise ObjectFreedError (ray.internal.free)."""
+        fired: list[Callable[[], None]] = []
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is not None:
+                    if entry.sealed:
+                        self._used -= entry.size
+                    entry.value = None
+                    entry.freed = True
+                    entry.event.set()
+                    fired.extend(entry.callbacks)
+                    entry.callbacks = []
+        for cb in fired:
+            cb()
+
+    # -- internals ----------------------------------------------------------
+
+    def _is_freed(self, oid: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(oid)
+            return entry is not None and entry.freed
+
+    def _ensure_entry(self, object_id: ObjectID) -> _Entry:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            return entry
+
+    def _wait_entry(self, object_id: ObjectID, timeout: float | None) -> _Entry:
+        entry = self._ensure_entry(object_id)
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(
+                f"Get timed out after {timeout}s waiting for {object_id}"
+            )
+        return entry
+
+    def _evict_locked(self, need_bytes: int) -> None:
+        """LRU eviction of sealed, unpinned objects (plasma eviction_policy.h)."""
+        candidates = sorted(
+            (
+                (entry.last_access, oid, entry)
+                for oid, entry in self._entries.items()
+                if entry.sealed and not entry.freed and not self._pinned_check(oid)
+            ),
+            key=lambda item: item[0],
+        )
+        reclaimed = 0
+        for _, oid, entry in candidates:
+            if reclaimed >= need_bytes:
+                break
+            reclaimed += entry.size
+            self._used -= entry.size
+            entry.value = None
+            entry.freed = True
+            entry.event.set()
+            del self._entries[oid]
+        if reclaimed < need_bytes:
+            raise OutOfMemoryError(
+                f"Object store over budget: need {need_bytes} more bytes but only "
+                f"{reclaimed} evictable"
+            )
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    @property
+    def num_objects(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e.sealed and not e.freed)

@@ -1,0 +1,257 @@
+"""Cluster scheduler: queue → policy → dispatch.
+
+Re-designs the reference's two-level scheduler for a shared control plane:
+`ClusterTaskManager` (queue → pick node → spillback → infeasible,
+raylet/scheduling/cluster_task_manager.h:33-42) collapses into a single dispatch
+loop because every node's availability is visible locally — spillback becomes a
+no-op. Policies preserved:
+
+  * hybrid (default): nodes scored by critical-resource utilization; prefer the
+    local/head node while its score stays under the 0.5 spread threshold, else
+    the lowest-utilization node (hybrid_scheduling_policy.h:29-50,
+    ray_config_def.h:193).
+  * SPREAD: round-robin over feasible nodes.
+  * NodeAffinity: hard or soft pin.
+  * PlacementGroup: resource request is rewritten onto the bundle's synthetic
+    group resources, which also pins the node (affinity_with_bundle policy).
+
+Infeasible tasks (no alive node could *ever* satisfy the request) are failed
+eagerly by default; with an autoscaler attached they instead queue and the
+demand is reported (cluster_task_manager.h:39-41 → autoscaler).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.controller import (
+    Controller,
+    NodeState,
+    PlacementGroupState,
+    pg_resource_name,
+)
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import OutOfResourcesError, PlacementGroupError
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SPREAD,
+)
+
+
+class PendingTask:
+    __slots__ = ("spec", "request", "target_node", "cancelled")
+
+    def __init__(self, spec: TaskSpec, request: dict[str, float]):
+        self.spec = spec
+        self.request = request
+        self.target_node: Optional[NodeState] = None
+        self.cancelled = False
+
+
+def resolve_pg_request(
+    spec: TaskSpec, request: dict[str, float], controller: Controller
+) -> tuple[dict[str, float], Optional[object]]:
+    """Rewrite a resource request onto placement-group synthetic resources."""
+    strategy = spec.scheduling_strategy
+    if not isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return request, None
+    pg = strategy.placement_group
+    record = controller.get_placement_group(pg.id)
+    if record is None or record.state == PlacementGroupState.REMOVED:
+        raise PlacementGroupError(f"Placement group {pg.id} does not exist")
+    index = strategy.placement_group_bundle_index
+    rewritten = {
+        pg_resource_name(res, pg.id, index if index >= 0 else None): amount
+        for res, amount in request.items()
+    }
+    return rewritten, record
+
+
+class Scheduler:
+    def __init__(
+        self,
+        controller: Controller,
+        dispatch: Callable[[TaskSpec, NodeState, dict[str, float]], None],
+        fail_task: Callable[[TaskSpec, BaseException], None],
+    ):
+        self._controller = controller
+        self._dispatch = dispatch
+        self._fail_task = fail_task
+        self._cond = threading.Condition()
+        self._queue: deque[PendingTask] = deque()
+        self._spread_cursor = 0
+        self._running = True
+        self.fail_on_infeasible = True
+        self._demand_listeners: list = []  # autoscaler hook
+        self._thread = threading.Thread(
+            target=self._loop, name="ray_tpu-scheduler", daemon=True
+        )
+        self._thread.start()
+        controller.add_resource_listener(self.notify)
+
+    # -- public -------------------------------------------------------------
+
+    def submit(self, spec: TaskSpec, request: dict[str, float]) -> None:
+        with self._cond:
+            self._queue.append(PendingTask(spec, request))
+            self._cond.notify_all()
+
+    def cancel(self, task_id) -> bool:
+        with self._cond:
+            for pending in self._queue:
+                if pending.spec.task_id == task_id:
+                    pending.cancelled = True
+                    self._cond.notify_all()
+                    return True
+        return False
+
+    def notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def add_demand_listener(self, fn) -> None:
+        self._demand_listeners.append(fn)
+
+    def pending_demand(self) -> list[dict[str, float]]:
+        with self._cond:
+            return [p.request for p in self._queue]
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=2.0)
+
+    # -- loop ---------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._queue:
+                    self._cond.wait()
+                if not self._running:
+                    return
+                batch = list(self._queue)
+            progressed = self._schedule_batch(batch)
+            # Drop the frame's reference to dispatched specs — the loop parks in
+            # cond.wait() and anything still bound here would never be GC'd.
+            batch.clear()
+            with self._cond:
+                if not progressed and self._queue and self._running:
+                    # Nothing placeable right now; wait for a resource change.
+                    self._cond.wait(timeout=0.2)
+
+    def _schedule_batch(self, batch: list[PendingTask]) -> bool:
+        progressed = False
+        for pending in batch:
+            if pending.cancelled:
+                self._remove(pending)
+                progressed = True
+                continue
+            try:
+                request, pg_record = resolve_pg_request(
+                    pending.spec, pending.request, self._controller
+                )
+            except PlacementGroupError as exc:
+                self._remove(pending)
+                self._fail_task(pending.spec, exc)
+                progressed = True
+                continue
+            try:
+                node = self._pick_node(pending.spec, request)
+            except OutOfResourcesError as exc:
+                self._remove(pending)
+                self._fail_task(pending.spec, exc)
+                progressed = True
+                continue
+            if node is None:
+                if not self._feasible_anywhere(request) and (
+                    pg_record is None or pg_record.state == PlacementGroupState.CREATED
+                ):
+                    if self.fail_on_infeasible and not self._demand_listeners:
+                        self._remove(pending)
+                        self._fail_task(
+                            pending.spec,
+                            OutOfResourcesError(
+                                f"No node can ever satisfy {request} for task "
+                                f"{pending.spec.name}"
+                            ),
+                        )
+                        progressed = True
+                    else:
+                        for fn in self._demand_listeners:
+                            fn(request)
+                continue
+            if node.allocate(request):
+                self._remove(pending)
+                progressed = True
+                self._dispatch(pending.spec, node, request)
+        return progressed
+
+    def _remove(self, pending: PendingTask) -> None:
+        with self._cond:
+            try:
+                self._queue.remove(pending)
+            except ValueError:
+                pass
+
+    # -- policies -----------------------------------------------------------
+
+    def _feasible_anywhere(self, request: dict[str, float]) -> bool:
+        return any(n.feasible(request) for n in self._controller.alive_nodes())
+
+    def _pick_node(
+        self, spec: TaskSpec, request: dict[str, float]
+    ) -> Optional[NodeState]:
+        nodes = self._controller.alive_nodes()
+        if not nodes:
+            return None
+        strategy = spec.scheduling_strategy
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            target = next(
+                (n for n in nodes if n.node_id.hex() == strategy.node_id), None
+            )
+            if target is not None and target.can_allocate(request):
+                return target
+            if strategy.soft:
+                return self._hybrid_pick(nodes, request)
+            if target is None:
+                # Hard affinity to a dead/unknown node can never be satisfied
+                # (the reference fails these as unschedulable).
+                raise OutOfResourcesError(
+                    f"Node {strategy.node_id} for hard NodeAffinity is not alive"
+                )
+            return None
+
+        candidates = [n for n in nodes if n.can_allocate(request)]
+        if not candidates:
+            return None
+
+        if strategy == SPREAD:
+            self._spread_cursor += 1
+            return candidates[self._spread_cursor % len(candidates)]
+
+        # PG strategies arrive here with rewritten resources; only nodes holding
+        # the group resources are candidates, so hybrid picking is safe.
+        return self._hybrid_pick(candidates, request)
+
+    def _hybrid_pick(
+        self, candidates: list[NodeState], request: dict[str, float]
+    ) -> Optional[NodeState]:
+        candidates = [n for n in candidates if n.can_allocate(request)]
+        if not candidates:
+            return None
+        threshold = GLOBAL_CONFIG.scheduler_spread_threshold
+        head_id = self._controller.head_node_id
+        local = next((n for n in candidates if n.node_id == head_id), None)
+        if local is not None and local.utilization(request) < threshold:
+            return local
+        scored = sorted(candidates, key=lambda n: n.utilization(request))
+        top_k = max(1, int(len(scored) * GLOBAL_CONFIG.scheduler_top_k_fraction))
+        return random.choice(scored[:top_k])

@@ -1,0 +1,80 @@
+"""Exception hierarchy (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised by user task code.
+
+    Stored as the task's result object; re-raised (with the remote traceback
+    appended) when the ref is `get`-ed — matching the reference's RayTaskError
+    (python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause: BaseException, traceback_str: str = "", task_name: str = ""):
+        self.cause = cause
+        self.traceback_str = traceback_str
+        self.task_name = task_name
+        super().__init__(f"Task {task_name or '<unknown>'} failed: {cause!r}\n{traceback_str}")
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is an instance of the cause's class."""
+        return self
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(reason)
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(reason)
+
+
+class ObjectFreedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class OutOfResourcesError(RayTpuError):
+    """No node in the cluster can ever satisfy the request (infeasible)."""
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class CrossLanguageError(RayTpuError):
+    pass

@@ -1,0 +1,168 @@
+"""Multi-node scheduling, placement groups, failure semantics
+(reference scope: tests/test_scheduling.py, test_placement_group*.py,
+test_actor_failures.py via cluster_utils)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+def test_multi_node_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4, num_tpus=4)
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 10.0
+    assert total["TPU"] == 4.0
+
+
+def test_tasks_spread_across_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(16)]))
+    assert len(nodes) >= 3
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    target = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=target.hex())
+    )
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote()) == target.hex()
+
+
+def test_tpu_resource_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    tpu_node = cluster.add_node(num_cpus=4, num_tpus=4)
+
+    @ray_tpu.remote(num_tpus=2)
+    def on_tpu():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_node_id(), ray_tpu.get_tpu_ids()
+
+    node_id, tpu_ids = ray_tpu.get(on_tpu.remote())
+    assert node_id == tpu_node.hex()
+    assert tpu_ids == [0, 1]
+
+
+def test_placement_group_strict_spread(ray_start_tpu_pod):
+    pg = placement_group(
+        [{"TPU": 4, "CPU": 1}] * 4, strategy="STRICT_SPREAD", name="slice-0"
+    )
+    assert pg.ready(timeout=5)
+    nodes = pg.bundle_node_ids()
+    assert len(set(nodes.values())) == 4  # one bundle per host
+
+
+def test_placement_group_task_targeting(ray_start_tpu_pod):
+    pg = placement_group([{"TPU": 4}] * 4, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=5)
+    nodes = pg.bundle_node_ids()
+
+    @ray_tpu.remote(num_tpus=4, num_cpus=0)
+    def which_host():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [
+        which_host.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(4)
+    ]
+    landed = ray_tpu.get(refs, timeout=10)
+    assert landed == [nodes[i] for i in range(4)]
+
+
+def test_placement_group_strict_spread_infeasible_pends(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    # 3 bundles over 2 nodes: STRICT_SPREAD cannot place -> stays pending,
+    # then a new node unblocks it (autoscaler-style recovery).
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout_seconds=0.5)
+    cluster.add_node(num_cpus=2)
+    assert pg.ready(timeout=5)
+
+
+def test_placement_group_removal_returns_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    before = ray_tpu.available_resources().get("CPU", 0)
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.ready(timeout=5)
+    during = ray_tpu.available_resources().get("CPU", 0)
+    assert during == before - 4
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    after = ray_tpu.available_resources().get("CPU", 0)
+    assert after == before
+
+
+def test_actor_restart_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"special": 1})
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1, resources={"special": 1})
+    class Survivor:
+        def ping(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    s = Survivor.remote()
+    first_node = ray_tpu.get(s.ping.remote(), timeout=10)
+    assert first_node == doomed.hex()
+    cluster.remove_node(doomed)
+    second_node = ray_tpu.get(s.ping.remote(), timeout=10)
+    assert second_node != doomed.hex()
+
+
+def test_actor_dies_with_node_without_restarts(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"pin": 1})
+
+    @ray_tpu.remote(resources={"pin": 1})
+    class Fragile:
+        def ping(self):
+            return "pong"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote(), timeout=10) == "pong"
+    cluster.remove_node(doomed)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(f.ping.remote(), timeout=10)
+
+
+def test_hybrid_policy_prefers_head_until_threshold(ray_start_cluster):
+    cluster = ray_start_cluster  # head has 2 CPUs
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def where():
+        time.sleep(0.2)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # 4 concurrent 1-CPU tasks on 2+2 CPUs must use both nodes.
+    refs = [where.remote() for _ in range(4)]
+    assert len(set(ray_tpu.get(refs, timeout=10))) == 2

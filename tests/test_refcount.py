@@ -1,0 +1,76 @@
+"""Ownership/GC protocol tests (reference: reference_count_test.cc scope)."""
+
+import gc
+
+import ray_tpu
+from ray_tpu._private.runtime import get_runtime
+
+
+def test_object_freed_when_ref_dropped(ray_start_regular):
+    runtime = get_runtime()
+    ref = ray_tpu.put([1, 2, 3])
+    oid = ref.id
+    assert runtime.store.contains(oid)
+    del ref
+    gc.collect()
+    assert not runtime.store.contains(oid)
+
+
+def test_object_kept_while_task_pending(ray_start_regular):
+    import time
+
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def slow_consume(x):
+        time.sleep(0.5)
+        return sum(x)
+
+    ref = ray_tpu.put([1, 2, 3])
+    oid = ref.id
+    result = slow_consume.remote(ref)
+    del ref  # only the submitted task holds it now
+    gc.collect()
+    assert runtime.store.contains(oid)
+    assert ray_tpu.get(result, timeout=10) == 6
+    del result
+    gc.collect()
+    # Arg ref was released after task finish.
+    for _ in range(50):
+        if not runtime.store.contains(oid):
+            break
+        time.sleep(0.05)
+    assert not runtime.store.contains(oid)
+
+
+def test_task_return_freed_after_handle_dropped(ray_start_regular):
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def make():
+        return "x" * 1000
+
+    ref = make.remote()
+    ray_tpu.get(ref, timeout=10)
+    oid = ref.id
+    assert runtime.store.contains(oid)
+    del ref
+    gc.collect()
+    assert not runtime.store.contains(oid)
+
+
+def test_stored_value_keeps_nested_ref_alive(ray_start_regular):
+    """A ref serialized inside another object is a borrow: the inner object
+    must survive the original handle being dropped."""
+    runtime = get_runtime()
+    inner = ray_tpu.put("payload")
+    inner_oid = inner.id
+    outer = ray_tpu.put({"inner": inner})
+    del inner
+    gc.collect()
+    assert runtime.store.contains(inner_oid)
+    fetched = ray_tpu.get(outer)
+    assert ray_tpu.get(fetched["inner"]) == "payload"
+    del fetched, outer
+    gc.collect()
+    assert not runtime.store.contains(inner_oid)
