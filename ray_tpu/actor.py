@@ -119,19 +119,30 @@ class ActorClass:
             # running cost, so unspecified means 0).
             default_num_cpus=0.0,
         )
-        actor_id, creation_ref = runtime.create_actor(
-            self._cls,
-            args,
-            kwargs,
-            name=name,
-            namespace=namespace,
-            resources=resources,
-            scheduling_strategy=opts.get("scheduling_strategy"),
-            max_restarts=opts.get("max_restarts", 0),
-            max_task_retries=opts.get("max_task_retries", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
-            detached=opts.get("lifetime") == "detached",
-        )
+        try:
+            actor_id, creation_ref = runtime.create_actor(
+                self._cls,
+                args,
+                kwargs,
+                name=name,
+                namespace=namespace,
+                resources=resources,
+                scheduling_strategy=opts.get("scheduling_strategy"),
+                max_restarts=opts.get("max_restarts", 0),
+                max_task_retries=opts.get("max_task_retries", 0),
+                max_concurrency=opts.get("max_concurrency", 1),
+                detached=opts.get("lifetime") == "detached",
+            )
+        except ValueError:
+            # Name race: another creator won between our existence check and
+            # registration; with get_if_exists, adopt the winner.
+            if name and opts.get("get_if_exists"):
+                existing = runtime.controller.get_named_actor(
+                    name, namespace or runtime.namespace
+                )
+                if existing is not None:
+                    return ActorHandle(existing, self._cls.__name__)
+            raise
         method_num_returns = {
             name: getattr(fn, "__ray_tpu_num_returns__")
             for name, fn in vars(self._cls).items()

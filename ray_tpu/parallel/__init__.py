@@ -1,0 +1,39 @@
+from ray_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    SliceTopology,
+    auto_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    DP_RULES,
+    EP_RULES,
+    FSDP_RULES,
+    SP_RULES,
+    STRATEGY_RULES,
+    TP_RULES,
+    batch_sharding,
+    infer_param_sharding,
+    named_sharding,
+    replicated,
+    spec_for,
+    tree_shardings,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "DP_RULES",
+    "EP_RULES",
+    "FSDP_RULES",
+    "MeshSpec",
+    "SP_RULES",
+    "STRATEGY_RULES",
+    "SliceTopology",
+    "TP_RULES",
+    "auto_mesh",
+    "batch_sharding",
+    "infer_param_sharding",
+    "named_sharding",
+    "replicated",
+    "spec_for",
+    "tree_shardings",
+]

@@ -1,0 +1,136 @@
+"""Device mesh formation.
+
+TPU-native replacement for the reference's process-group bootstrap
+(train/torch/config.py:69 _setup_torch_process_group + util/collective NCCL
+rendezvous): on TPU the framework's job is *mesh formation* — pick axis sizes,
+build a `jax.sharding.Mesh` over the slice's devices, and hand out shardings;
+the collectives themselves are emitted by XLA over ICI (SURVEY.md §2.5).
+
+Axis convention (orders matter: outermost→innermost = slowest→fastest varying,
+so axes that should ride ICI neighbors go last):
+
+    dp    — pure data parallel (replicated params)
+    fsdp  — data parallel with sharded params/optimizer (ZeRO-3 analog)
+    sp    — sequence/context parallelism (ring attention neighbors)
+    tp    — tensor parallelism (megatron-style sharded matmuls)
+    ep    — expert parallelism (MoE)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape. Axis size -1 means 'absorb remaining devices'
+    (at most one axis may be -1); absent axes are size 1."""
+
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.axis_sizes()
+        wildcards = [k for k, v in sizes.items() if v == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"At most one -1 axis allowed, got {wildcards}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wildcards:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh axes {sizes} require {fixed} devices but {n_devices} present"
+            )
+        return MeshSpec(**sizes)
+
+    def active_axes(self) -> list[str]:
+        return [name for name in AXIS_ORDER if getattr(self, name) > 1]
+
+    def build(self, devices: Optional[Sequence] = None):
+        """Create the `jax.sharding.Mesh`. All five axes are always present
+        (size-1 axes are free), so sharding rules can name any axis."""
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        spec = self.resolve(len(devices))
+        sizes = spec.axis_sizes()
+        dev_array = np.asarray(devices).reshape([sizes[a] for a in AXIS_ORDER])
+        return Mesh(dev_array, AXIS_ORDER)
+
+
+def auto_mesh(
+    n_devices: int,
+    *,
+    strategy: str = "dp",
+    tp: int = 1,
+    sp: int = 1,
+) -> MeshSpec:
+    """Heuristic mesh shapes for common strategies.
+
+    strategy: "dp" (replicated), "fsdp" (sharded params), "tp+fsdp", "sp+fsdp".
+    """
+    if strategy == "dp":
+        return MeshSpec(dp=-1).resolve(n_devices)
+    if strategy == "fsdp":
+        return MeshSpec(fsdp=-1).resolve(n_devices)
+    if strategy == "tp+fsdp":
+        return MeshSpec(fsdp=-1, tp=tp).resolve(n_devices)
+    if strategy == "sp+fsdp":
+        return MeshSpec(fsdp=-1, sp=sp).resolve(n_devices)
+    raise ValueError(f"Unknown mesh strategy {strategy!r}")
+
+
+@dataclass
+class SliceTopology:
+    """Description of a TPU slice as scheduled by the placement layer:
+    a slice is an atomic multi-host placement group (SURVEY.md §7 phase 2)."""
+
+    num_hosts: int
+    chips_per_host: int
+    generation: str = "v5e"
+
+    @property
+    def num_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+    def bundle_specs(self) -> list[dict[str, float]]:
+        """One STRICT_SPREAD bundle per host, each carrying that host's chips."""
+        return [
+            {"TPU": float(self.chips_per_host), "CPU": 1.0}
+            for _ in range(self.num_hosts)
+        ]
+
+
+def initialize_multi_host(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Per-host JAX distributed init (the mesh-forming actor group calls this
+    once per host before building the global mesh). Thin wrapper so tests can
+    fake it; real multi-host TPU uses jax.distributed.initialize over DCN."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -7,12 +7,19 @@ set BEFORE jax import so XLA sees the flag.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin (sitecustomize) force-registers itself and overrides
+# JAX_PLATFORMS; the config knob below wins over both. Must run before any
+# backend initialization.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
