@@ -1,0 +1,11 @@
+from ray_tpu.ops.attention import mha_reference
+from ray_tpu.ops.flash_attention import attention, flash_attention
+from ray_tpu.ops.ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "ring_self_attention",
+]

@@ -1,0 +1,87 @@
+"""Reference attention math (pure JAX).
+
+Ground truth for the Pallas kernels and the CPU fallback path. Array layout is
+[batch, seq, heads, head_dim] (flax convention) everywhere in the ops package.
+The reference framework has no attention ops at all (SURVEY.md §2.4: SP/ring
+attention absent upstream) — this subsystem is net-new, designed TPU-first.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-matrix multi-head attention. q,k,v: [B, S, H, D] → [B, S, H, D]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k_len - q_len)
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _chunk_attn_partial(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    sm_scale: float,
+    mask: Optional[jax.Array],
+):
+    """One blockwise-attention partial: returns (o_unnorm, m, l) in f32 so
+    partials from different KV chunks can be merged with log-sum-exp algebra.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; mask: broadcastable to [B, H, Sq, Sk].
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * sm_scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [B, H, Sq]
+    p = jnp.exp(logits - m[..., None])
+    if mask is not None:
+        p = p * mask  # kill exp(0)=1 rows when everything was masked
+    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def merge_partials(o1, m1, l1, o2, m2, l2):
+    """Merge two blockwise softmax partials (the flash/ring update rule)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    # o is [B, Sq, H, D]; scales are [B, H, Sq] -> [B, Sq, H, 1]
+    s1 = jnp.transpose(a1, (0, 2, 1))[..., None]
+    s2 = jnp.transpose(a2, (0, 2, 1))[..., None]
+    o = o1 * s1 + o2 * s2
+    return o, m, l
+
+
+def finalize_partial(o, m, l):
+    """Normalize an accumulated partial into the final attention output."""
+    denom = jnp.where(l == 0.0, 1.0, l)
+    scale = jnp.transpose(1.0 / denom, (0, 2, 1))[..., None]
+    return o * scale

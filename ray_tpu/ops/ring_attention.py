@@ -1,0 +1,111 @@
+"""Ring attention — sequence/context parallelism over the `sp` mesh axis.
+
+Absent from the reference entirely (SURVEY.md §2.4: "SP/CP, ring attention ...
+Absent — must be designed fresh"). Design: shard the sequence over the `sp`
+axis; each device keeps its Q shard resident and circulates K/V shards around
+the ring with `lax.ppermute` (XLA lowers to ICI neighbor transfers), merging
+blockwise-softmax partials per hop. Communication overlaps compute via XLA's
+latency-hiding scheduler; memory per device is O(S/n) so context length scales
+linearly with ring size.
+
+Causality with a sharded sequence: chunk c attends fully to chunks < c,
+causally within chunk c, not at all to chunks > c. All devices execute the
+same program (SPMD): masked-out hops compute and contribute zero weight.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import (
+    _chunk_attn_partial,
+    finalize_partial,
+    merge_partials,
+)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard ring attention body. Must run inside shard_map/pjit with the
+    sequence dim of q/k/v sharded over `axis_name`.
+
+    q, k, v (local shards): [B, S_local, H, D] → [B, S_local, H, D].
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    b, _, h, _ = q.shape
+
+    def make_mask(kv_chunk_idx):
+        """[B, H, Sq, Sk] boolean mask for the current hop's chunk relation."""
+        if not causal:
+            return None
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+        intra = q_ids >= k_ids  # same-chunk causal
+        full = kv_chunk_idx < my_idx
+        none = kv_chunk_idx > my_idx
+        mask = jnp.where(none, False, jnp.where(full, True, intra))
+        return jnp.broadcast_to(mask, (b, h, s_local, s_local))
+
+    # Hop 0: attend to the local K/V chunk.
+    o, m, l = _chunk_attn_partial(q, k, v, sm_scale, make_mask(my_idx))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(step, carry):
+        o, m, l, k_cur, v_cur = carry
+        # Shift K/V one step around the ring; after `step` shifts we hold the
+        # chunk produced by (my_idx - step) mod n.
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_idx = jax.lax.rem(my_idx - step + n, n)
+        o2, m2, l2 = _chunk_attn_partial(q, k_cur, v_cur, sm_scale, make_mask(kv_idx))
+        o, m, l = merge_partials(o, m, l, o2, m2, l2)
+        return (o, m, l, k_cur, v_cur)
+
+    if n > 1:
+        o, m, l, _, _ = jax.lax.fori_loop(
+            1, n, hop, (o, m, l, k, v), unroll=True
+        )
+    return finalize_partial(o, m, l).astype(q.dtype)
+
+
+def ring_self_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    seq_axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+) -> jax.Array:
+    """Convenience wrapper: shard_map `ring_attention` over the mesh with the
+    sequence dim on `seq_axis` and batch on the data axes."""
+    from jax import shard_map
+
+    spec = P(batch_axes, seq_axis, None, None)
+    fn = functools.partial(
+        ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+    )
+    sharded = shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+    )
+    return sharded(q, k, v)
