@@ -106,6 +106,6 @@ def ring_self_attention(
         ring_attention, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
     )
     sharded = shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )
     return sharded(q, k, v)
