@@ -1,0 +1,31 @@
+from ray_tpu.models.gpt import (
+    GPT,
+    GPTConfig,
+    cross_entropy_loss,
+    gpt2_125m,
+    gpt2_350m,
+    gpt2_760m,
+)
+from ray_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+
+__all__ = [
+    "GPT",
+    "GPTConfig",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "cross_entropy_loss",
+    "gpt2_125m",
+    "gpt2_350m",
+    "gpt2_760m",
+]

@@ -1,0 +1,147 @@
+"""GPT-2-style decoder-only transformer (flax), TPU-first.
+
+The "GPT-2 125M language modeling" config from BASELINE.json. Every weight
+carries *logical* axis names via nn.with_logical_partitioning, so one model
+definition serves dp / fsdp / tp / sp by swapping the rules table
+(ray_tpu.parallel.sharding) — the design that replaces the reference's
+FSDP/DeepSpeed integration wrappers (train/huggingface/accelerate/).
+
+Sequence parallelism: attention goes through ray_tpu.ops (flash kernel on TPU;
+ring attention when the caller runs the model under shard_map with the seq dim
+sharded on `sp`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import attention as attention_op
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # 50257 padded to a multiple of 128 for the MXU
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    max_seq_len: int = 1024
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    # "flash" (pallas kernel), "reference", or "ring" (requires sp-sharded
+    # inputs under shard_map with axis name `sp`).
+    attention_impl: str = "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def gpt2_125m(**overrides) -> "GPTConfig":
+    return GPTConfig(**overrides)
+
+
+def gpt2_350m(**overrides) -> "GPTConfig":
+    return GPTConfig(num_layers=24, num_heads=16, embed_dim=1024, **overrides)
+
+
+def gpt2_760m(**overrides) -> "GPTConfig":
+    return GPTConfig(num_layers=24, num_heads=20, embed_dim=1280, **overrides)
+
+
+def _dense(features, logical_axes, dtype, name=None, use_bias=True):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        use_bias=use_bias,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, (logical_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
+        b, s, _ = h.shape
+        qkv = _dense(3 * cfg.embed_dim, ("embed", "heads"), cfg.dtype, name="attn_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        if cfg.attention_impl == "ring":
+            attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+        else:
+            attn = attention_op(q, k, v, causal=True, impl=cfg.attention_impl)
+        attn = attn.reshape(b, s, cfg.embed_dim)
+        attn = _dense(cfg.embed_dim, ("heads", "embed"), cfg.dtype, name="attn_proj")(attn)
+        x = x + attn
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        h = _dense(cfg.mlp_ratio * cfg.embed_dim, ("embed", "mlp"), cfg.dtype,
+                   name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = _dense(cfg.embed_dim, ("mlp", "embed"), cfg.dtype, name="mlp_out")(h)
+        return x + h
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.config
+        b, s = tokens.shape
+        wte = nn.Embed(
+            cfg.vocab_size,
+            cfg.embed_dim,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_seq_len,
+            cfg.embed_dim,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.01), (None, "embed")
+            ),
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(s)[None, :])
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Tied LM head: logits via the embedding matrix (f32 for the softmax).
+        logits = wte.attend(x.astype(jnp.float32))
+        return logits
+
+
+def cross_entropy_loss(logits, targets, mask: Optional[jax.Array] = None):
+    """Token-level LM loss. logits [B,S,V], targets [B,S] int."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def logical_axis_rules(rules_table: dict) -> list[tuple[str, Any]]:
+    """Convert a ray_tpu.parallel rules table into flax logical-axis rules
+    (for nn.logical_to_mesh_sharding)."""
+    return [(name, axis) for name, axis in rules_table.items()]
