@@ -1,0 +1,77 @@
+"""Run/scaling/failure/checkpoint configs (reference: python/ray/air/config.py —
+ScalingConfig :91, RunConfig :704, FailureConfig :523, CheckpointConfig :574).
+
+TPU-first deltas: ScalingConfig speaks chips and hosts (`num_workers` = TPU
+*hosts*, one worker process per host — SURVEY.md CS4 TPU translation), and
+`chips_per_worker` replaces `use_gpu`/`resources_per_worker` GPU counts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    # TPU chips each worker (host) drives; 0 = CPU-only training.
+    chips_per_worker: int = 0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: dict[str, float] = field(default_factory=dict)
+    # Placement strategy for the worker bundles: a TPU slice is an atomic
+    # multi-host gang, so chips default to STRICT_SPREAD (one bundle per host).
+    placement_strategy: Optional[str] = None
+
+    @property
+    def use_tpu(self) -> bool:
+        return self.chips_per_worker > 0
+
+    def bundle_specs(self) -> list[dict[str, float]]:
+        bundle: dict[str, float] = {"CPU": float(self.cpus_per_worker)}
+        if self.chips_per_worker:
+            bundle["TPU"] = float(self.chips_per_worker)
+        bundle.update(self.resources_per_worker)
+        return [dict(bundle) for _ in range(self.num_workers)]
+
+    def strategy(self) -> str:
+        if self.placement_strategy:
+            return self.placement_strategy
+        return "STRICT_SPREAD" if self.use_tpu and self.num_workers > 1 else "PACK"
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.chips_per_worker
+
+
+@dataclass
+class FailureConfig:
+    # Number of worker-group restarts allowed; -1 = unlimited.
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results"
+        )
+        return os.path.join(base, self.name) if self.name else base

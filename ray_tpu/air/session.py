@@ -1,0 +1,117 @@
+"""Training session API — what user train loops call.
+
+Reference: air/session.py (report :43, get_checkpoint :97, get_dataset_shard
+:359) backed by train/_internal/session.py's rendezvous queue (:76,:421): each
+worker runs the user loop on a runner thread; `report` blocks until the driver
+consumes the result, which is what makes scheduler-driven early stopping (ASHA
+kill mid-epoch) safe.
+
+The active session lives in thread-local state set by the worker runner.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_TL = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    trial_name: str = ""
+    trial_id: str = ""
+    # Devices/mesh info installed by the backend (JaxBackend).
+    devices: Any = None
+    mesh: Any = None
+    extras: dict = field(default_factory=dict)
+
+
+class _Session:
+    """One per worker-runner thread."""
+
+    FINISHED = object()
+
+    def __init__(self, context: TrainContext, checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[dict] = None):
+        self.context = context
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        # 1-deep rendezvous: report() blocks until the driver consumes.
+        self.result_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self.stop_event = threading.Event()
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint]) -> None:
+        if self.stop_event.is_set():
+            raise StopIteration("Training stopped by the driver")
+        self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        if self.stop_event.is_set():
+            raise StopIteration("Training stopped by the driver")
+
+    def finish(self) -> None:
+        self.result_queue.put(self.FINISHED)
+
+
+def _set_session(session: Optional[_Session]) -> None:
+    _TL.session = session
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_TL, "session", None)
+
+
+def _require_session() -> _Session:
+    session = _get_session()
+    if session is None:
+        raise RuntimeError(
+            "No training session active; this API must be called inside a "
+            "train_loop_per_worker"
+        )
+    return session
+
+
+# -- public API --------------------------------------------------------------
+
+
+def report(metrics: dict, *, checkpoint: Optional[Checkpoint] = None) -> None:
+    _require_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require_session().loaded_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    shards = _require_session().dataset_shards
+    if name not in shards:
+        raise KeyError(f"No dataset shard named {name!r}; have {list(shards)}")
+    return shards[name]
+
+
+def get_world_rank() -> int:
+    return _require_session().context.world_rank
+
+
+def get_world_size() -> int:
+    return _require_session().context.world_size
+
+
+def get_local_rank() -> int:
+    return _require_session().context.local_rank
+
+
+def get_context() -> TrainContext:
+    return _require_session().context
+
+
+def get_mesh():
+    """The device mesh the backend formed for this worker (JaxTrainer)."""
+    return _require_session().context.mesh

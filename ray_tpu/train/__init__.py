@@ -1,0 +1,55 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.air.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_mesh,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxBackendConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax_trainer import (
+    JaxTrainer,
+    prepare_batch,
+    prepare_params,
+    prepare_step,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BackendExecutor",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxBackendConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingWorkerError",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "get_mesh",
+    "get_world_rank",
+    "get_world_size",
+    "prepare_batch",
+    "prepare_params",
+    "prepare_step",
+    "report",
+]

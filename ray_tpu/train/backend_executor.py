@@ -1,0 +1,97 @@
+"""BackendExecutor — drives the worker group through a training run.
+
+Reference: train/_internal/backend_executor.py:45 (placement group :164,
+start_training :342, _restart :625). Orchestration only — runs no math.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+    ):
+        self._backend_config = backend_config
+        self._backend: Backend = backend_config.backend_cls()
+        self._scaling = scaling_config
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            num_workers=self._scaling.num_workers,
+            bundle_specs=self._scaling.bundle_specs(),
+            strategy=self._scaling.strategy(),
+        )
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: dict,
+        checkpoint: Optional[Checkpoint],
+        dataset_shard_fn: Optional[Callable[[int, int], Optional[dict]]] = None,
+    ) -> None:
+        assert self.worker_group is not None
+        self._backend.on_training_start(self.worker_group, self._backend_config)
+        refs = []
+        for rank, worker in enumerate(self.worker_group.workers):
+            shards = (
+                dataset_shard_fn(rank, self._scaling.num_workers)
+                if dataset_shard_fn
+                else None
+            )
+            refs.append(
+                worker.start_training.remote(train_fn, config, checkpoint, shards)
+            )
+        ray_tpu.get(refs, timeout=300.0)
+
+    def next_results(self) -> Optional[list[dict]]:
+        """One rendezvous round: every worker's next report, or None when all
+        finished. Raises TrainingWorkerError wrapping the first worker error."""
+        assert self.worker_group is not None
+        refs = [w.next_result.remote() for w in self.worker_group.workers]
+        try:
+            results = ray_tpu.get(refs, timeout=None)
+        except Exception as exc:
+            raise TrainingWorkerError(str(exc)) from exc
+        finished = [r is None for r in results]
+        if all(finished):
+            return None
+        if any(finished):
+            raise TrainingWorkerError(
+                "Workers finished unevenly — mismatched session.report calls"
+            )
+        return results
+
+    def restart(self) -> None:
+        """Tear down and re-form the worker group (reference _restart :625).
+        On TPU a failed host invalidates the whole mesh, so restart is always
+        whole-group (SURVEY.md §7 hard part 4)."""
+        self.shutdown()
+        self.start()
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group, self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,135 @@
+"""DataParallelTrainer — the Trainer surface.
+
+Reference: train/data_parallel_trainer.py:58,422 + train/trainer.py:41
+(TrainingIterator) + base_trainer.py:559 (fit). Differences by design: the
+trainer runs standalone (the reference wraps every fit in a 1-trial Tune run;
+here Tune drives trainers through the same interface instead, keeping the
+fit path free of tune plumbing).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+
+class DataParallelTrainer:
+    _default_backend_config: BackendConfig = BackendConfig()
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_config = dict(train_loop_config or {})
+        self._backend_config = backend_config or self._default_backend_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._datasets = dict(datasets or {})
+        self._resume_checkpoint = resume_from_checkpoint
+        self._result_callbacks: list[Callable[[dict], None]] = []
+
+    def add_result_callback(self, fn: Callable[[dict], None]) -> None:
+        """Called with rank-0 metrics after every report round (Tune hook)."""
+        self._result_callbacks.append(fn)
+
+    # -- dataset sharding ----------------------------------------------------
+
+    def _dataset_shard_fn(self, rank: int, world_size: int) -> Optional[dict]:
+        if not self._datasets:
+            return None
+        shards = {}
+        for name, ds in self._datasets.items():
+            split = getattr(ds, "streaming_split", None)
+            if split is not None:
+                # ray_tpu.data.Dataset: per-worker streaming shard.
+                shards[name] = ds.streaming_split(world_size)[rank]
+            elif isinstance(ds, (list, tuple)):
+                shards[name] = ds[rank::world_size]
+            else:
+                shards[name] = ds
+        return shards
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit(self) -> Result:
+        failure_config = self.run_config.failure_config or FailureConfig()
+        max_failures = failure_config.max_failures
+        ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
+        executor = BackendExecutor(self._backend_config, self.scaling_config)
+        history: list[dict] = []
+        error: Optional[BaseException] = None
+        failures = 0
+        start = time.monotonic()
+
+        executor.start()
+        try:
+            while True:
+                try:
+                    self._run_training(executor, ckpt_manager, history)
+                    break
+                except TrainingWorkerError as exc:
+                    failures += 1
+                    if max_failures != -1 and failures > max_failures:
+                        error = exc
+                        break
+                    # Whole-group restart from the latest checkpoint
+                    # (TPU mesh restarts are all-or-nothing).
+                    self._resume_checkpoint = ckpt_manager.latest or self._resume_checkpoint
+                    executor.restart()
+        finally:
+            executor.shutdown()
+
+        metrics = dict(ckpt_manager.latest_metrics or (history[-1] if history else {}))
+        metrics.setdefault("time_total_s", time.monotonic() - start)
+        metrics["training_iteration"] = len(history)
+        return Result(
+            metrics=metrics,
+            checkpoint=ckpt_manager.best,
+            error=error,
+            path=self.run_config.resolved_storage_path(),
+            metrics_history=history,
+        )
+
+    def _run_training(
+        self,
+        executor: BackendExecutor,
+        ckpt_manager: CheckpointManager,
+        history: list[dict],
+    ) -> None:
+        executor.start_training(
+            self._train_fn,
+            self._train_config,
+            self._resume_checkpoint,
+            self._dataset_shard_fn,
+        )
+        while True:
+            results = executor.next_results()
+            if results is None:
+                return
+            rank0 = results[0]
+            metrics = rank0["metrics"]
+            # Rank 0's checkpoint is authoritative (reference: master-rank
+            # persistence, train/_internal/checkpoint.py:35).
+            checkpoint = rank0.get("checkpoint")
+            if checkpoint is not None:
+                ckpt_manager.register(checkpoint, metrics)
+            else:
+                ckpt_manager.latest_metrics = dict(metrics)
+            history.append(dict(metrics))
+            for callback in self._result_callbacks:
+                callback(dict(metrics))

@@ -1,0 +1,74 @@
+"""JaxTrainer + in-loop helpers — the TPU-native Train path.
+
+The BASELINE.json north-star surface: `JaxTrainer` is the `TorchTrainer`
+equivalent whose workers drive TPU chips and whose gradient sync is XLA
+(`lax.psum` over ICI) instead of NCCL DDP. One worker per TPU host
+(single-controller-per-host); the backend forms the mesh before the user loop
+starts (reference flow: CS4 in SURVEY.md).
+
+In-loop helpers (the `prepare_model`/`prepare_data_loader` analogs,
+train/torch/train_loop_utils.py:245,329): `prepare_params` shards a param tree
+onto the mesh, `prepare_batch` shards inputs over the data axes, `prepare_step`
+jits the step with donated params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.air import session
+from ray_tpu.train.backend import JaxBackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+class JaxTrainer(DataParallelTrainer):
+    _default_backend_config = JaxBackendConfig()
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        kwargs.setdefault("backend_config", JaxBackendConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+# -- in-loop helpers ---------------------------------------------------------
+
+
+def prepare_params(params: Any, rules: Optional[dict] = None) -> Any:
+    """Shard a parameter pytree onto the session mesh (FSDP heuristic when the
+    tree carries no logical-axis metadata)."""
+    import jax
+
+    from ray_tpu.parallel import FSDP_RULES, infer_param_sharding
+
+    mesh = session.get_mesh()
+    shardings = infer_param_sharding(mesh, params, rules or FSDP_RULES)
+    return jax.device_put(params, shardings)
+
+
+def prepare_batch(batch: Any) -> Any:
+    """Shard a batch pytree over the mesh's data axes."""
+    import jax
+
+    from ray_tpu.parallel import batch_sharding
+
+    mesh = session.get_mesh()
+    sharding = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def prepare_step(step_fn: Callable, donate_argnums=(0,)) -> Callable:
+    """jit the train step; shardings propagate from the (already-sharded)
+    inputs, XLA inserts the gradient collectives."""
+    import jax
+
+    return jax.jit(step_fn, donate_argnums=donate_argnums)
+
+
+def report_from_rank0(metrics: dict, checkpoint=None) -> None:
+    """report() with identical metrics from every rank; checkpoint only from
+    rank 0 (the reference persists the master rank's checkpoint)."""
+    if session.get_world_rank() == 0:
+        session.report(metrics, checkpoint=checkpoint)
+    else:
+        session.report(metrics)

@@ -99,16 +99,35 @@ class _GroupState:
 
 
 # Group membership is per *worker*, not per module: with the threaded engine
-# every worker shares this module, so the registry lives in thread-local
-# storage (each task/actor runs on its own thread; a real per-host process
-# backend gets per-process isolation for free).
+# every worker shares this module. The registry resolution order is
+#   1. the active training session (train worker runner threads — survives the
+#      backend setting up the group on a different actor-pool thread), then
+#   2. thread-local storage (generic task/actor usage).
+# A real per-host process backend gets per-process isolation for free.
 _TL = threading.local()
 
 
 def _registry() -> dict[str, _GroupState]:
+    from ray_tpu.air.session import _get_session
+
+    session = _get_session()
+    if session is not None:
+        return session.context.extras.setdefault("collective_groups", {})
     if not hasattr(_TL, "groups"):
         _TL.groups = {}
     return _TL.groups
+
+
+def create_group_state(
+    world_size: int, rank: int, group_name: str = "default"
+) -> _GroupState:
+    """Create/join the group's rendezvous actor without registering in any
+    ambient store — for backends that manage membership explicitly."""
+    actor_name = f"__collective_group_{group_name}"
+    handle = _CollectiveGroupActor.options(
+        name=actor_name, get_if_exists=True, max_concurrency=max(world_size * 2, 8)
+    ).remote(world_size)
+    return _GroupState(handle, world_size, rank)
 
 
 def init_collective_group(
@@ -117,11 +136,7 @@ def init_collective_group(
     """Join a collective group (each member calls once). Matches the reference
     signature (util/collective/collective.py:120) minus the backend arg — the
     backend is always actor-space here."""
-    actor_name = f"__collective_group_{group_name}"
-    handle = _CollectiveGroupActor.options(
-        name=actor_name, get_if_exists=True, max_concurrency=max(world_size * 2, 8)
-    ).remote(world_size)
-    _registry()[group_name] = _GroupState(handle, world_size, rank)
+    _registry()[group_name] = create_group_state(world_size, rank, group_name)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
