@@ -56,6 +56,8 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"  # "max" | "min"
+    checkpoint_at_end: bool = True
+    checkpoint_frequency: int = 0
 
     def __post_init__(self):
         if self.checkpoint_score_order not in ("max", "min"):
@@ -68,6 +70,8 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # Tune stopping criteria, e.g. {"training_iteration": 10}.
+    stop: Optional[dict] = None
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:

@@ -41,11 +41,52 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self._datasets = dict(datasets or {})
         self._resume_checkpoint = resume_from_checkpoint
+        self._latest_checkpoint: Optional[Checkpoint] = None
         self._result_callbacks: list[Callable[[dict], None]] = []
 
     def add_result_callback(self, fn: Callable[[dict], None]) -> None:
         """Called with rank-0 metrics after every report round (Tune hook)."""
         self._result_callbacks.append(fn)
+
+    def as_trainable(self) -> type:
+        """Wrap this trainer for Tune — every fit() becomes a (potentially
+        multi-worker) trial, the reference's BaseTrainer.fit-wraps-a-1-trial-
+        Tune-run flow inverted (train/base_trainer.py:559). Trial configs merge
+        under the `train_loop_config` key, like the reference."""
+        import copy
+
+        from ray_tpu.tune.trainable import wrap_function
+
+        base = self
+
+        def train_fn(config):
+            from ray_tpu.air import session
+
+            trainer = copy.copy(base)
+            trainer._train_config = {
+                **base._train_config,
+                **(config.get("train_loop_config") or {}),
+            }
+            if "scaling_config" in config:
+                trainer.scaling_config = config["scaling_config"]
+            # Tune-side restore (failure retry / PBT exploit / experiment
+            # resume) arrives as the trial's loaded checkpoint — seed the
+            # trainer so workers resume instead of restarting from scratch.
+            restored = session.get_checkpoint()
+            if restored is not None:
+                trainer._resume_checkpoint = restored
+            trainer._result_callbacks = list(base._result_callbacks)
+            # Forward each result round — with the workers' latest checkpoint,
+            # so Tune-side save()/restore() (PBT, retries) is meaningful.
+            trainer.add_result_callback(
+                lambda m: session.report(m, checkpoint=trainer._latest_checkpoint)
+            )
+            result = trainer.fit()
+            if result.error:
+                raise result.error
+
+        train_fn.__name__ = type(base).__name__
+        return wrap_function(train_fn)
 
     # -- dataset sharding ----------------------------------------------------
 
@@ -128,6 +169,7 @@ class DataParallelTrainer:
             checkpoint = rank0.get("checkpoint")
             if checkpoint is not None:
                 ckpt_manager.register(checkpoint, metrics)
+                self._latest_checkpoint = checkpoint
             else:
                 ckpt_manager.latest_metrics = dict(metrics)
             history.append(dict(metrics))

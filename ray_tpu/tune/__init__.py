@@ -1,0 +1,65 @@
+"""ray_tpu.tune — hyperparameter search (reference: python/ray/tune/).
+
+Built purely on the public task/actor API, like the reference: the controller
+is an event loop over trial actors (tune/execution/tune_controller.py:49).
+"""
+
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    loguniform,
+    qrandn,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.search.searcher import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    RandomSearch,
+    Searcher,
+)
+from ray_tpu.tune.trainable import Trainable, with_parameters, wrap_function
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+
+# ASHAScheduler is the reference's public alias.
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "RandomSearch",
+    "ResultGrid",
+    "Searcher",
+    "Trainable",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "qrandn",
+    "quniform",
+    "randint",
+    "randn",
+    "run",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "wrap_function",
+]

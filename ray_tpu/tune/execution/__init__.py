@@ -1,0 +1,2 @@
+"""Trial execution."""
+from ray_tpu.tune.execution.tune_controller import TuneController  # noqa

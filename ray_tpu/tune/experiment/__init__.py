@@ -1,0 +1,2 @@
+"""Experiment metadata."""
+from ray_tpu.tune.experiment.trial import Trial  # noqa

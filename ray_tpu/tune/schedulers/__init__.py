@@ -1,0 +1,250 @@
+"""Trial schedulers.
+
+Reference: tune/schedulers/ — ASHA (async_hyperband.py:17,185 _Bracket), PBT
+(pbt.py:216 exploit/explore, _explore :49), MedianStopping
+(median_stopping_rule.py), FIFO. Decisions returned to the controller:
+CONTINUE / STOP / PAUSE.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Dict, Optional
+
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.search.sample import Domain
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    PAUSE = "PAUSE"
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]) -> None:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def _score(self, result: dict) -> float:
+        value = result[self.metric]
+        return value if self.mode == "max" else -value
+
+    def on_trial_add(self, trial: Trial) -> None:
+        pass
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]) -> None:
+        pass
+
+    def on_trial_remove(self, trial: Trial) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py).
+
+    Rung milestones at grace_period * reduction_factor^k; at each rung a trial
+    stops unless it is in the top 1/reduction_factor of completed rung entries.
+    Asynchronous: decisions use whatever results have arrived, no waiting for
+    the full rung population.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+        brackets: int = 1,
+    ):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> list of recorded scores
+        self._rungs: Dict[float, list] = defaultdict(list)
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t = math.ceil(t * reduction_factor)
+        self._milestones = milestones
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return TrialScheduler.CONTINUE
+        t = result[self.time_attr]
+        if t >= self.max_t:
+            return TrialScheduler.STOP
+        score = self._score(result)
+        decision = TrialScheduler.CONTINUE
+        for milestone in self._milestones:
+            if t >= milestone and milestone not in self._passed(trial):
+                rung = self._rungs[milestone]
+                rung.append(score)
+                self._passed(trial).add(milestone)
+                cutoff = self._cutoff(rung)
+                if cutoff is not None and score < cutoff:
+                    decision = TrialScheduler.STOP
+        return decision
+
+    def _passed(self, trial: Trial) -> set:
+        if not hasattr(trial, "_asha_passed"):
+            trial._asha_passed = set()
+        return trial._asha_passed
+
+    def _cutoff(self, rung: list) -> Optional[float]:
+        if len(rung) < self.rf:
+            return None  # not enough evidence yet
+        q = 1.0 - 1.0 / self.rf
+        s = sorted(rung)
+        idx = int(q * (len(s) - 1))
+        return s[idx]
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score falls below the median of running
+    averages at the same step (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._scores: Dict[str, list] = defaultdict(list)
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        if self.metric not in result:
+            return TrialScheduler.CONTINUE
+        t = result.get(self.time_attr, 0)
+        self._scores[trial.trial_id].append(self._score(result))
+        if t < self.grace_period or len(self._scores) < self.min_samples:
+            return TrialScheduler.CONTINUE
+        means = [sum(v) / len(v) for k, v in self._scores.items() if v]
+        means.sort()
+        median = means[len(means) // 2]
+        own_best = max(self._scores[trial.trial_id])
+        if own_best < median:
+            return TrialScheduler.STOP
+        return TrialScheduler.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py:216).
+
+    Past each perturbation_interval, bottom-quantile trials EXPLOIT (restore
+    the checkpoint of a random top-quantile trial) then EXPLORE (mutate
+    hyperparameters: resample with prob `resample_probability`, else scale
+    continuous values by 1.2/0.8). Requires checkpointable trainables; the
+    controller performs the actual save/restore when it sees the decision.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[dict] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._latest: Dict[str, float] = {}
+        # trial_id -> (source_trial, new_config) set when exploit is due;
+        # the controller pops and applies it.
+        self.pending_exploits: Dict[str, tuple] = {}
+        self._trials: Dict[str, Trial] = {}
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self._trials[trial.trial_id] = trial
+
+    def on_trial_remove(self, trial: Trial) -> None:
+        self._trials.pop(trial.trial_id, None)
+        self._latest.pop(trial.trial_id, None)
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]) -> None:
+        self.on_trial_remove(trial)
+
+    def _quantiles(self):
+        scored = [
+            (tid, self._latest[tid]) for tid in self._trials if tid in self._latest
+        ]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda kv: kv[1])
+        n = max(1, int(len(scored) * self.quantile))
+        bottom = [tid for tid, _ in scored[:n]]
+        top = [tid for tid, _ in scored[-n:]]
+        return bottom, top
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        for key, mutation in self.mutations.items():
+            current = new.get(key)
+            if isinstance(mutation, Domain):
+                if current is None or self._rng.random() < self.resample_prob:
+                    new[key] = mutation.sample(self._rng)
+                else:
+                    new[key] = mutation.perturb(current, self._rng)
+            elif isinstance(mutation, list):
+                if current in mutation and self._rng.random() >= self.resample_prob:
+                    # step to a neighbor value
+                    i = mutation.index(current)
+                    j = min(len(mutation) - 1, max(0, i + self._rng.choice([-1, 1])))
+                    new[key] = mutation[j]
+                else:
+                    new[key] = self._rng.choice(mutation)
+            elif callable(mutation):
+                new[key] = mutation()
+        return new
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        if self.metric not in result:
+            return TrialScheduler.CONTINUE
+        t = result.get(self.time_attr, 0)
+        self._latest[trial.trial_id] = self._score(result)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        bottom, top = self._quantiles()
+        if trial.trial_id in bottom and top:
+            src_id = self._rng.choice(top)
+            if src_id != trial.trial_id:
+                src = self._trials[src_id]
+                # Clone the source's config, then explore around it.
+                new_config = self._explore(dict(src.config))
+                self.pending_exploits[trial.trial_id] = (src, new_config)
+        return TrialScheduler.CONTINUE

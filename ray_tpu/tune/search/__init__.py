@@ -1,0 +1,3 @@
+"""Search algorithms."""
+from ray_tpu.tune.search.sample import *  # noqa
+from ray_tpu.tune.search.searcher import BasicVariantGenerator, ConcurrencyLimiter, RandomSearch, Searcher  # noqa
