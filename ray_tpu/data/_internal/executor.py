@@ -1,0 +1,496 @@
+"""Streaming executor.
+
+Reference: data/_internal/execution/streaming_executor.py:48,173 — a pull-based
+pipeline: each operator stage holds a bounded set of in-flight tasks over
+blocks in the object store; downstream pulls as results land, so memory stays
+bounded (backpressure) and stages overlap. All-to-all ops (sort/shuffle/
+repartition) are barriers that materialize their input, like the reference's
+AllToAllOperator.
+
+Fusion: consecutive one-to-one ops become ONE task per block
+(logical/rules/operator_fusion.py equivalent) — each block makes a single
+worker round-trip.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    BlockAccessor,
+    BlockMetadata,
+    DelegatingBlockBuilder,
+    batch_to_format,
+)
+from ray_tpu.data._internal.logical_plan import (
+    Aggregate,
+    Filter,
+    FlatMap,
+    InputData,
+    Limit,
+    LogicalPlan,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    Zip,
+)
+
+# Bounded in-flight tasks per map stage (the streaming budget; reference
+# gates on resource budgets in streaming_executor_state.py).
+DEFAULT_MAX_IN_FLIGHT = 8
+
+RefBundle = Tuple[Any, BlockMetadata]  # (block_ref, metadata)
+
+
+# -- fused map transform ------------------------------------------------------
+
+
+def _apply_one_to_one(ops: List[Any], block: Any) -> Any:
+    """Run a fused chain of one-to-one ops over one block, returning a block."""
+    for op in ops:
+        acc = BlockAccessor.for_block(block)
+        if isinstance(op, MapBatches):
+            out = DelegatingBlockBuilder()
+            n = acc.num_rows()
+            size = op.batch_size or max(1, n)
+            for start in range(0, n, size):
+                piece = acc.slice(start, min(n, start + size))
+                batch = batch_to_format(piece, op.batch_format)
+                result = op.fn(batch, *op.fn_args, **op.fn_kwargs)
+                out.add_batch(result)
+            block = out.build()
+        elif isinstance(op, MapRows):
+            out = DelegatingBlockBuilder()
+            for row in acc.iter_rows():
+                out.add(op.fn(row))
+            block = out.build()
+        elif isinstance(op, Filter):
+            out = DelegatingBlockBuilder()
+            for row in acc.iter_rows():
+                if op.fn(row):
+                    out.add(row)
+            block = out.build()
+        elif isinstance(op, FlatMap):
+            out = DelegatingBlockBuilder()
+            for row in acc.iter_rows():
+                for produced in op.fn(row):
+                    out.add(produced)
+            block = out.build()
+        else:
+            raise TypeError(f"Not a one-to-one op: {op}")
+    return block
+
+
+def _map_task(ops: List[Any], block: Any):
+    t0 = time.perf_counter()
+    result = _apply_one_to_one(ops, block)
+    meta = BlockAccessor.for_block(result).metadata(
+        exec_stats={"wall_s": time.perf_counter() - t0}
+    )
+    return result, meta
+
+
+def _read_task(read_fn: Callable, ops: List[Any]):
+    """Execute one ReadTask (+ fused downstream one-to-one ops)."""
+    t0 = time.perf_counter()
+    builder = DelegatingBlockBuilder()
+    for block in read_fn():
+        if ops:
+            block = _apply_one_to_one(ops, block)
+        builder.add_batch(block)
+    result = builder.build()
+    meta = BlockAccessor.for_block(result).metadata(
+        exec_stats={"wall_s": time.perf_counter() - t0}
+    )
+    return result, meta
+
+
+class _MapWorker:
+    """Actor-pool worker for compute=actors map stages (reference:
+    execution/operators/actor_pool_map_operator.py:34)."""
+
+    def __init__(self, ops: List[Any]):
+        self._ops = ops
+
+    def map(self, block: Any):
+        return _map_task(self._ops, block)
+
+
+# -- stage iterators ----------------------------------------------------------
+
+
+def _iter_map_stage(
+    upstream: Iterator[RefBundle],
+    ops: List[Any],
+    stats: Optional[dict] = None,
+) -> Iterator[RefBundle]:
+    """Bounded-in-flight, order-preserving task pipeline over blocks."""
+    compute = next((op.compute for op in ops if op.compute is not None), None)
+    num_cpus = max((op.num_cpus for op in ops), default=1.0)
+    name = "+".join(op.name for op in ops)
+
+    if compute is not None:
+        yield from _iter_actor_pool_stage(upstream, ops, compute, num_cpus)
+        return
+
+    remote_map = ray_tpu.remote(_map_task).options(
+        num_returns=2, num_cpus=num_cpus, name=name
+    )
+    pending: deque = deque()
+    upstream = iter(upstream)
+    exhausted = False
+    t_start = time.perf_counter()
+    while True:
+        while not exhausted and len(pending) < DEFAULT_MAX_IN_FLIGHT:
+            try:
+                block_ref, _ = next(upstream)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append(remote_map.remote(ops, block_ref))
+        if not pending:
+            break
+        block_ref, meta_ref = pending.popleft()
+        meta = ray_tpu.get(meta_ref)
+        yield block_ref, meta
+    if stats is not None:
+        stats.setdefault(name, {})["wall_s"] = time.perf_counter() - t_start
+
+
+def _iter_actor_pool_stage(
+    upstream: Iterator[RefBundle],
+    ops: List[Any],
+    compute: Any,
+    num_cpus: float,
+) -> Iterator[RefBundle]:
+    if isinstance(compute, tuple):
+        pool_size = compute[1]
+    else:
+        pool_size = int(compute)
+    worker_cls = ray_tpu.remote(_MapWorker).options(num_cpus=num_cpus)
+    workers = [worker_cls.remote(ops) for _ in range(pool_size)]
+    pending: deque = deque()
+    upstream = iter(upstream)
+    exhausted = False
+    i = 0
+    try:
+        while True:
+            while not exhausted and len(pending) < 2 * pool_size:
+                try:
+                    block_ref, _ = next(upstream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                worker = workers[i % pool_size]
+                i += 1
+                pending.append(
+                    worker.map.options(num_returns=2).remote(block_ref)
+                )
+            if not pending:
+                break
+            block_ref, meta_ref = pending.popleft()
+            yield block_ref, ray_tpu.get(meta_ref)
+    finally:
+        for w in workers:
+            ray_tpu.kill(w)
+
+
+def _iter_read_stage(
+    read_tasks: List[Callable], fused_ops: List[Any]
+) -> Iterator[RefBundle]:
+    remote_read = ray_tpu.remote(_read_task).options(num_returns=2, name="Read")
+    pending: deque = deque()
+    tasks = iter(read_tasks)
+    exhausted = False
+    while True:
+        while not exhausted and len(pending) < DEFAULT_MAX_IN_FLIGHT:
+            try:
+                rt = next(tasks)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append(remote_read.remote(rt, fused_ops))
+        if not pending:
+            break
+        block_ref, meta_ref = pending.popleft()
+        yield block_ref, ray_tpu.get(meta_ref)
+
+
+def _iter_limit_stage(
+    upstream: Iterator[RefBundle], limit: int
+) -> Iterator[RefBundle]:
+    taken = 0
+    for block_ref, meta in upstream:
+        if taken >= limit:
+            return
+        n = meta.num_rows
+        if n is None:
+            n = BlockAccessor.for_block(ray_tpu.get(block_ref)).num_rows()
+        if taken + n <= limit:
+            taken += n
+            yield block_ref, meta
+        else:
+            want = limit - taken
+            block = ray_tpu.get(block_ref)
+            piece = BlockAccessor.for_block(block).slice(0, want)
+            acc = BlockAccessor.for_block(piece)
+            yield ray_tpu.put(piece), acc.metadata()
+            taken = limit
+            return
+
+
+# -- all-to-all stages (barriers) --------------------------------------------
+
+
+def _materialize(upstream: Iterator[RefBundle]) -> List[RefBundle]:
+    return list(upstream)
+
+
+def _split_block_task(block: Any, n: int):
+    """Split one block into n near-equal slices (repartition fan-out)."""
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    out = []
+    for i in range(n):
+        start = (rows * i) // n
+        end = (rows * (i + 1)) // n
+        out.append(acc.slice(start, end))
+    return out
+
+
+def _concat_blocks_task(*blocks):
+    builder = DelegatingBlockBuilder()
+    for b in blocks:
+        builder.add_batch(b)
+    result = builder.build()
+    return result, BlockAccessor.for_block(result).metadata()
+
+
+def _repartition(bundles: List[RefBundle], n: int) -> Iterator[RefBundle]:
+    """Minimal-movement repartition: split every block into n parts, then
+    concat part i of every block into output block i (push-based shuffle
+    skeleton, reference: push_based_shuffle.py)."""
+    split = ray_tpu.remote(_split_block_task)
+    concat = ray_tpu.remote(_concat_blocks_task).options(num_returns=2)
+    if not bundles:
+        for _ in range(n):
+            ref, meta_ref = concat.remote([])
+            yield ref, ray_tpu.get(meta_ref)
+        return
+    parts = [
+        split.options(num_returns=n).remote(block_ref, n)
+        for block_ref, _ in bundles
+    ]
+    # parts[j] = n refs of block j's slices.
+    for i in range(n):
+        shard_refs = [p[i] if n > 1 else p for p in parts]
+        ref, meta_ref = concat.remote(*shard_refs)
+        yield ref, ray_tpu.get(meta_ref)
+
+
+def _shuffle_block_task(block: Any, seed):
+    acc = BlockAccessor.for_block(block)
+    rows = list(acc.iter_rows())
+    _random.Random(seed).shuffle(rows)
+    builder = DelegatingBlockBuilder()
+    for r in rows:
+        builder.add(r)
+    result = builder.build()
+    return result, BlockAccessor.for_block(result).metadata()
+
+
+def _random_shuffle(
+    bundles: List[RefBundle], seed: Optional[int]
+) -> Iterator[RefBundle]:
+    """Global shuffle: repartition slices round-robin with a seeded permutation
+    of slice assignment, then per-block row shuffle."""
+    n = max(1, len(bundles))
+    rng = _random.Random(seed)
+    shuffle_one = ray_tpu.remote(_shuffle_block_task).options(num_returns=2)
+    repartitioned = list(_repartition(bundles, n))
+    rng.shuffle(repartitioned)
+    for i, (block_ref, _) in enumerate(repartitioned):
+        ref, meta_ref = shuffle_one.remote(
+            block_ref, None if seed is None else seed + i
+        )
+        yield ref, ray_tpu.get(meta_ref)
+
+
+def _sort_block_task(block: Any, key, descending: bool):
+    acc = BlockAccessor.for_block(block)
+    rows = sorted(acc.iter_rows(), key=_key_fn(key), reverse=descending)
+    builder = DelegatingBlockBuilder()
+    for r in rows:
+        builder.add(r)
+    result = builder.build()
+    return result, BlockAccessor.for_block(result).metadata()
+
+
+def _key_fn(key):
+    if callable(key):
+        return key
+    if isinstance(key, str):
+        return lambda row: row[key]
+    return lambda row: row
+
+
+def _sample_boundaries_task(block: Any, key, n_samples: int):
+    acc = BlockAccessor.for_block(block)
+    rows = list(acc.iter_rows())
+    if not rows:
+        return []
+    kf = _key_fn(key)
+    step = max(1, len(rows) // max(1, n_samples))
+    return sorted(kf(r) for r in rows[::step])
+
+
+def _partition_block_task(block: Any, key, boundaries: list, descending: bool):
+    """Range-partition one block by the sorted boundaries → len(boundaries)+1 parts."""
+    import bisect
+
+    acc = BlockAccessor.for_block(block)
+    kf = _key_fn(key)
+    n_parts = len(boundaries) + 1
+    parts: List[list] = [[] for _ in range(n_parts)]
+    for row in acc.iter_rows():
+        idx = bisect.bisect_right(boundaries, kf(row))
+        if descending:
+            idx = n_parts - 1 - idx
+        parts[idx].append(row)
+    return parts
+
+
+def _merge_sorted_task(key, descending, *parts):
+    rows = [r for p in parts for r in p]
+    rows.sort(key=_key_fn(key), reverse=descending)
+    builder = DelegatingBlockBuilder()
+    for r in rows:
+        builder.add(r)
+    result = builder.build()
+    return result, BlockAccessor.for_block(result).metadata()
+
+
+def _sort(
+    bundles: List[RefBundle], key, descending: bool
+) -> Iterator[RefBundle]:
+    """Distributed sample-sort (reference: data/_internal/planner/sort.py):
+    sample boundaries → range-partition each block → merge per range."""
+    if not bundles:
+        return
+    if len(bundles) == 1:
+        sort_one = ray_tpu.remote(_sort_block_task).options(num_returns=2)
+        ref, meta_ref = sort_one.remote(bundles[0][0], key, descending)
+        yield ref, ray_tpu.get(meta_ref)
+        return
+    n = len(bundles)
+    sample = ray_tpu.remote(_sample_boundaries_task)
+    samples = ray_tpu.get(
+        [sample.remote(ref, key, 8) for ref, _ in bundles]
+    )
+    flat = sorted(s for block in samples for s in block)
+    if not flat:
+        for ref, meta in bundles:
+            yield ref, meta
+        return
+    boundaries = [flat[(len(flat) * i) // n] for i in range(1, n)]
+    partition = ray_tpu.remote(_partition_block_task)
+    merge = ray_tpu.remote(_merge_sorted_task).options(num_returns=2)
+    parts = [
+        partition.options(num_returns=n).remote(ref, key, boundaries, descending)
+        for ref, _ in bundles
+    ]
+    for i in range(n):
+        shard = [p[i] if n > 1 else p for p in parts]
+        ref, meta_ref = merge.remote(key, descending, *shard)
+        yield ref, ray_tpu.get(meta_ref)
+
+
+def _zip_blocks_task(a: Any, b: Any):
+    da = BlockAccessor.for_block(a).to_numpy_dict()
+    db = BlockAccessor.for_block(b).to_numpy_dict()
+    merged = dict(da)
+    for k, v in db.items():
+        merged[k if k not in merged else f"{k}_1"] = v
+    return merged, BlockAccessor.for_block(merged).metadata()
+
+
+# -- plan compilation ---------------------------------------------------------
+
+
+def execute_streaming(
+    plan: LogicalPlan, stats: Optional[dict] = None
+) -> Iterator[RefBundle]:
+    """Compile the logical plan into chained stage iterators and stream."""
+    stream: Optional[Iterator[RefBundle]] = None
+    ops = list(plan.ops)
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if isinstance(op, InputData):
+            stream = iter(list(zip(op.block_refs, op.metadata)))
+            i += 1
+        elif isinstance(op, Read):
+            # Fuse trailing one-to-one ops into the read tasks.
+            fused: List[Any] = []
+            j = i + 1
+            while j < len(ops) and ops[j].is_one_to_one() and ops[j].compute is None:
+                fused.append(ops[j])
+                j += 1
+            stream = _iter_read_stage(op.read_tasks, fused)
+            i = j
+        elif op.is_one_to_one():
+            fused = [op]
+            j = i + 1
+            while (
+                j < len(ops)
+                and ops[j].is_one_to_one()
+                and (ops[j].compute is None) == (op.compute is None)
+            ):
+                fused.append(ops[j])
+                j += 1
+            stream = _iter_map_stage(stream, fused, stats)
+            i = j
+        elif isinstance(op, Limit):
+            stream = _iter_limit_stage(stream, op.limit)
+            i += 1
+        elif isinstance(op, Repartition):
+            stream = _repartition(_materialize(stream), op.num_blocks)
+            i += 1
+        elif isinstance(op, RandomShuffle):
+            stream = _random_shuffle(_materialize(stream), op.seed)
+            i += 1
+        elif isinstance(op, Sort):
+            stream = _sort(_materialize(stream), op.key, op.descending)
+            i += 1
+        elif isinstance(op, Union):
+            def _union(base, others):
+                yield from base
+                for other_plan in others:
+                    yield from execute_streaming(other_plan)
+
+            stream = _union(stream, op.others)
+            i += 1
+        elif isinstance(op, Zip):
+            zip_task = ray_tpu.remote(_zip_blocks_task).options(num_returns=2)
+
+            def _zip(base, other_plan):
+                other = execute_streaming(other_plan)
+                for (ref_a, _), (ref_b, _) in zip(base, other):
+                    ref, meta_ref = zip_task.remote(ref_a, ref_b)
+                    yield ref, ray_tpu.get(meta_ref)
+
+            stream = _zip(stream, op.other)
+            i += 1
+        else:
+            raise TypeError(f"Unknown logical op {op}")
+    return stream if stream is not None else iter(())

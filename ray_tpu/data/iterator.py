@@ -1,0 +1,200 @@
+"""DataIterator: batched consumption with prefetch.
+
+Reference: data/iterator.py + _internal/block_batching/ — blocks stream from
+the executor, a background thread prefetches and re-chunks them into
+fixed-size batches in the requested format. Fixed batch sizes are the
+TPU-friendly default (XLA recompiles on shape change); `drop_last=True` plus
+bucketed padding upstream keeps step shapes static.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, DelegatingBlockBuilder, batch_to_format
+
+
+class DataIterator:
+    """Iterable over batches; each __iter__ restarts the underlying plan
+    (one epoch), unless constructed over a fixed block stream."""
+
+    def __init__(self, make_stream: Callable[[], Iterator], owner=None):
+        self._make_stream = make_stream
+        self._owner = owner  # Dataset, for stats/repr
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_batches: int = 2,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        stream = self._make_stream()
+
+        def block_iter():
+            for block_ref, _meta in stream:
+                yield ray_tpu.get(block_ref)
+
+        batches = _rebatch(
+            block_iter(),
+            batch_size,
+            batch_format,
+            drop_last,
+            local_shuffle_buffer_size,
+            local_shuffle_seed,
+        )
+        if prefetch_batches and prefetch_batches > 0:
+            batches = _prefetch(batches, prefetch_batches)
+        return batches
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block_ref, _ in self._make_stream():
+            yield from BlockAccessor.for_block(ray_tpu.get(block_ref)).iter_rows()
+
+    def __iter__(self):
+        return self.iter_batches()
+
+    def materialize_refs(self) -> list:
+        return list(self._make_stream())
+
+
+def _rebatch(
+    blocks: Iterator[Any],
+    batch_size: int,
+    batch_format: str,
+    drop_last: bool,
+    shuffle_buffer: Optional[int],
+    shuffle_seed: Optional[int],
+) -> Iterator[Any]:
+    """Slice a stream of blocks into exact-size batches."""
+    import random
+
+    rng = random.Random(shuffle_seed)
+    builder = DelegatingBlockBuilder()
+    pending_rows = 0
+
+    def drain(builder, want):
+        block = builder.build()
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        out = []
+        start = 0
+        while n - start >= want:
+            out.append(acc.slice(start, start + want))
+            start += want
+        rest = DelegatingBlockBuilder()
+        if start < n:
+            rest.add_batch(acc.slice(start, n))
+        return out, rest, n - start
+
+    if shuffle_buffer:
+        # Local shuffle: accumulate rows into a bounded buffer, emit randomly.
+        buffer: list = []
+
+        def shuffled_rows():
+            for block in blocks:
+                for row in BlockAccessor.for_block(block).iter_rows():
+                    buffer.append(row)
+                    if len(buffer) >= shuffle_buffer:
+                        idx = rng.randrange(len(buffer))
+                        buffer[idx], buffer[-1] = buffer[-1], buffer[idx]
+                        yield buffer.pop()
+            rng.shuffle(buffer)
+            yield from buffer
+
+        row_iter = shuffled_rows()
+        batch_rows: list = []
+        for row in row_iter:
+            batch_rows.append(row)
+            if len(batch_rows) == batch_size:
+                yield batch_to_format(batch_rows, batch_format)
+                batch_rows = []
+        if batch_rows and not drop_last:
+            yield batch_to_format(batch_rows, batch_format)
+        return
+
+    for block in blocks:
+        builder.add_batch(block)
+        pending_rows += BlockAccessor.for_block(block).num_rows()
+        if pending_rows >= batch_size:
+            full, builder, pending_rows = drain(builder, batch_size)
+            for piece in full:
+                yield batch_to_format(piece, batch_format)
+    if pending_rows and not drop_last:
+        yield batch_to_format(builder.build(), batch_format)
+
+
+def _prefetch(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    DONE = object()
+    err: list = []
+
+    def produce():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:
+            err.append(e)
+        finally:
+            q.put(DONE)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is DONE:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+class _SplitCoordinator:
+    """Feeds N consumers from one block stream (reference: OutputSplitter,
+    operators/output_splitter.py, behind Dataset.streaming_split). Lazy: the
+    feeder thread starts on the first consumer pull; round-robin assignment
+    with per-consumer bounded queues for backpressure."""
+
+    def __init__(self, make_stream: Callable[[], Iterator], n: int, equal: bool):
+        self._make_stream = make_stream
+        self._n = n
+        self._equal = equal
+        self._queues = [queue.Queue(maxsize=4) for _ in range(n)]
+        self._started = False
+        self._lock = threading.Lock()
+        self._DONE = object()
+
+    def _ensure_started(self):
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            t = threading.Thread(target=self._feed, daemon=True)
+            t.start()
+
+    def _feed(self):
+        i = 0
+        try:
+            for bundle in self._make_stream():
+                self._queues[i % self._n].put(bundle)
+                i += 1
+        finally:
+            for q in self._queues:
+                q.put(self._DONE)
+
+    def stream_for(self, rank: int) -> Iterator:
+        self._ensure_started()
+        q = self._queues[rank]
+        while True:
+            item = q.get()
+            if item is self._DONE:
+                return
+            yield item
