@@ -257,7 +257,11 @@ def _materialize(upstream: Iterator[RefBundle]) -> List[RefBundle]:
 
 
 def _split_block_task(block: Any, n: int):
-    """Split one block into n near-equal slices (repartition fan-out)."""
+    """Split one block into n near-equal slices (repartition fan-out).
+
+    Returns the bare slice when n == 1: with num_returns=1 the runtime seals
+    the whole return value into one ref, so a 1-list would nest.
+    """
     acc = BlockAccessor.for_block(block)
     rows = acc.num_rows()
     out = []
@@ -265,7 +269,7 @@ def _split_block_task(block: Any, n: int):
         start = (rows * i) // n
         end = (rows * (i + 1)) // n
         out.append(acc.slice(start, end))
-    return out
+    return out if n > 1 else out[0]
 
 
 def _concat_blocks_task(*blocks):
@@ -424,6 +428,43 @@ def _zip_blocks_task(a: Any, b: Any):
     return merged, BlockAccessor.for_block(merged).metadata()
 
 
+def _align_to_boundaries(
+    bundles: List[RefBundle], boundaries: List[int], row_counts: List[int]
+) -> Iterator[Any]:
+    """Re-slice a bundle list so output block row-counts match `boundaries`
+    (the reference re-aligns zip inputs the same way). Yields block refs.
+    `row_counts` carries the precomputed rows of each input bundle."""
+    slice_task = ray_tpu.remote(
+        lambda block, s, e: BlockAccessor.for_block(block).slice(s, e)
+    )
+    concat = ray_tpu.remote(_concat_blocks_task).options(num_returns=2)
+    src = iter(zip(bundles, row_counts))
+    cur_ref = None
+    cur_rows = 0
+    offset = 0
+    for want in boundaries:
+        pieces = []
+        need = want
+        while need > 0:
+            if cur_ref is None:
+                (cur_ref, _meta), cur_rows = next(src)
+                offset = 0
+            take = min(need, cur_rows - offset)
+            if take == cur_rows and offset == 0:
+                pieces.append(cur_ref)
+            else:
+                pieces.append(slice_task.remote(cur_ref, offset, offset + take))
+            offset += take
+            need -= take
+            if offset >= cur_rows:
+                cur_ref = None
+        if len(pieces) == 1:
+            yield pieces[0]
+        else:
+            ref, _meta_ref = concat.remote(*pieces)
+            yield ref
+
+
 # -- plan compilation ---------------------------------------------------------
 
 
@@ -449,12 +490,15 @@ def execute_streaming(
             stream = _iter_read_stage(op.read_tasks, fused)
             i = j
         elif op.is_one_to_one():
+            # Fuse only stages with identical compute specs — fusing actor
+            # pools of different sizes would silently run the later stage
+            # under the earlier stage's pool.
             fused = [op]
             j = i + 1
             while (
                 j < len(ops)
                 and ops[j].is_one_to_one()
-                and (ops[j].compute is None) == (op.compute is None)
+                and ops[j].compute == op.compute
             ):
                 fused.append(ops[j])
                 j += 1
@@ -464,7 +508,25 @@ def execute_streaming(
             stream = _iter_limit_stage(stream, op.limit)
             i += 1
         elif isinstance(op, Repartition):
-            stream = _repartition(_materialize(stream), op.num_blocks)
+            bundles = _materialize(stream)
+            if op.shuffle:
+                # Full shuffle-repartition: redistribute slices, then permute
+                # rows within each output block (reference push_based_shuffle
+                # with shuffle=True contract).
+                shuffle_one = ray_tpu.remote(_shuffle_block_task).options(
+                    num_returns=2
+                )
+
+                def _shuffled(parts):
+                    for ref, _meta in parts:
+                        # seed=None → fresh permutation every plan execution
+                        # (each epoch re-runs the plan and must re-shuffle).
+                        out_ref, meta_ref = shuffle_one.remote(ref, None)
+                        yield out_ref, ray_tpu.get(meta_ref)
+
+                stream = _shuffled(list(_repartition(bundles, op.num_blocks)))
+            else:
+                stream = _repartition(bundles, op.num_blocks)
             i += 1
         elif isinstance(op, RandomShuffle):
             stream = _random_shuffle(_materialize(stream), op.seed)
@@ -484,8 +546,31 @@ def execute_streaming(
             zip_task = ray_tpu.remote(_zip_blocks_task).options(num_returns=2)
 
             def _zip(base, other_plan):
-                other = execute_streaming(other_plan)
-                for (ref_a, _), (ref_b, _) in zip(base, other):
+                base_bundles = list(base)
+                other_bundles = list(execute_streaming(other_plan))
+
+                def _rows(bundles):
+                    out = []
+                    for ref, meta in bundles:
+                        n = meta.num_rows
+                        if n is None:
+                            n = BlockAccessor.for_block(
+                                ray_tpu.get(ref)
+                            ).num_rows()
+                        out.append(n)
+                    return out
+
+                base_rows = _rows(base_bundles)
+                other_rows = _rows(other_bundles)
+                if sum(base_rows) != sum(other_rows):
+                    raise ValueError(
+                        "zip: datasets have different row counts "
+                        f"({sum(base_rows)} vs {sum(other_rows)})"
+                    )
+                aligned = _align_to_boundaries(
+                    other_bundles, base_rows, other_rows
+                )
+                for (ref_a, _), ref_b in zip(base_bundles, aligned):
                     ref, meta_ref = zip_task.remote(ref_a, ref_b)
                     yield ref, ray_tpu.get(meta_ref)
 

@@ -159,42 +159,67 @@ def _prefetch(it: Iterator[Any], depth: int) -> Iterator[Any]:
 
 class _SplitCoordinator:
     """Feeds N consumers from one block stream (reference: OutputSplitter,
-    operators/output_splitter.py, behind Dataset.streaming_split). Lazy: the
-    feeder thread starts on the first consumer pull; round-robin assignment
-    with per-consumer bounded queues for backpressure."""
+    operators/output_splitter.py, behind Dataset.streaming_split).
+
+    Each epoch = one pass of the plan, with its OWN set of per-consumer
+    bounded queues (so concurrent epochs never interleave in one queue, and
+    a rank that abandons an epoch mid-stream starts the next epoch on a
+    clean queue). An epoch's feeder thread starts lazily when the first rank
+    asks for it; it ends the epoch with one DONE sentinel per queue. Queues
+    are dropped once every rank has finished (or skipped past) the epoch.
+
+    equal=True assigns each bundle to the consumer with the fewest rows so
+    far (greedy row balancing); equal=False round-robins whole blocks.
+    """
 
     def __init__(self, make_stream: Callable[[], Iterator], n: int, equal: bool):
         self._make_stream = make_stream
         self._n = n
         self._equal = equal
-        self._queues = [queue.Queue(maxsize=4) for _ in range(n)]
-        self._started = False
+        self._epoch_queues: dict = {}
+        self._epoch_finished: dict = {}
+        self._epochs_consumed = [0] * n
         self._lock = threading.Lock()
         self._DONE = object()
 
-    def _ensure_started(self):
-        with self._lock:
-            if self._started:
-                return
-            self._started = True
-            t = threading.Thread(target=self._feed, daemon=True)
-            t.start()
-
-    def _feed(self):
+    def _feed(self, queues) -> None:
+        rows_sent = [0] * self._n
         i = 0
         try:
             for bundle in self._make_stream():
-                self._queues[i % self._n].put(bundle)
+                if self._equal:
+                    target = min(range(self._n), key=lambda r: rows_sent[r])
+                else:
+                    target = i % self._n
+                n_rows = bundle[1].num_rows if bundle[1] is not None else None
+                rows_sent[target] += n_rows or 1
+                queues[target].put(bundle)
                 i += 1
         finally:
-            for q in self._queues:
+            for q in queues:
                 q.put(self._DONE)
 
     def stream_for(self, rank: int) -> Iterator:
-        self._ensure_started()
-        q = self._queues[rank]
-        while True:
-            item = q.get()
-            if item is self._DONE:
-                return
-            yield item
+        with self._lock:
+            epoch = self._epochs_consumed[rank]
+            self._epochs_consumed[rank] += 1
+            if epoch not in self._epoch_queues:
+                queues = [queue.Queue(maxsize=4) for _ in range(self._n)]
+                self._epoch_queues[epoch] = queues
+                self._epoch_finished[epoch] = 0
+                threading.Thread(
+                    target=self._feed, args=(queues,), daemon=True
+                ).start()
+            q = self._epoch_queues[epoch][rank]
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                yield item
+        finally:
+            with self._lock:
+                self._epoch_finished[epoch] += 1
+                if self._epoch_finished[epoch] == self._n:
+                    self._epoch_queues.pop(epoch, None)
+                    self._epoch_finished.pop(epoch, None)

@@ -1,0 +1,290 @@
+"""Data library tests (model: reference python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(rt):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+
+def test_map_batches_numpy(rt):
+    ds = rd.range(64).map_batches(lambda b: {"x": b["id"] * 2})
+    got = ds.take_all()
+    assert got[5] == {"x": 10}
+
+
+def test_map_filter_flatmap_chain(rt):
+    ds = (
+        rd.range(20)
+        .map(lambda r: {"v": r["id"] + 1})
+        .filter(lambda r: r["v"] % 2 == 0)
+        .flat_map(lambda r: [r, r])
+    )
+    rows = ds.take_all()
+    assert len(rows) == 20
+    assert all(r["v"] % 2 == 0 for r in rows)
+
+
+def test_limit_and_schema(rt):
+    ds = rd.range(1000).limit(17)
+    assert ds.count() == 17
+    assert "id" in str(rd.range(4).schema())
+
+
+def test_repartition(rt):
+    ds = rd.range(100, parallelism=4).repartition(10)
+    assert ds.materialize().num_blocks() == 10
+    assert ds.count() == 100
+
+
+def test_random_shuffle_preserves_rows(rt):
+    ds = rd.range(50).random_shuffle(seed=7)
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == list(range(50))
+
+
+def test_sort(rt):
+    ds = rd.from_items([{"k": v} for v in [5, 3, 9, 1, 7, 2, 8]])
+    out = [r["k"] for r in ds.sort("k").take_all()]
+    assert out == sorted(out)
+    out_d = [r["k"] for r in ds.sort("k", descending=True).take_all()]
+    assert out_d == sorted(out_d, reverse=True)
+
+
+def test_sort_distributed(rt):
+    ds = rd.range(200, parallelism=8).map(lambda r: {"k": (r["id"] * 37) % 200})
+    out = [r["k"] for r in ds.sort("k").take_all()]
+    assert out == sorted(out)
+
+
+def test_groupby_aggregate(rt):
+    ds = rd.from_items(
+        [{"g": i % 3, "v": i} for i in range(30)], parallelism=4
+    )
+    rows = ds.groupby("g").sum("v").take_all()
+    by_g = {r["g"]: r["sum(v)"] for r in rows}
+    assert by_g == {
+        0: sum(i for i in range(30) if i % 3 == 0),
+        1: sum(i for i in range(30) if i % 3 == 1),
+        2: sum(i for i in range(30) if i % 3 == 2),
+    }
+
+
+def test_groupby_count_mean_std(rt):
+    ds = rd.from_items([{"g": i % 2, "v": float(i)} for i in range(10)])
+    got = ds.groupby("g").count().take_all()
+    assert all(r["count()"] == 5 for r in got)
+    means = {r["g"]: r["mean(v)"] for r in ds.groupby("g").mean("v").take_all()}
+    assert means[0] == pytest.approx(4.0)
+    assert means[1] == pytest.approx(5.0)
+
+
+def test_global_aggregates(rt):
+    ds = rd.from_items([{"v": i} for i in range(11)])
+    assert ds.sum("v") == 55
+    assert ds.min("v") == 0
+    assert ds.max("v") == 10
+    assert ds.mean("v") == 5.0
+
+
+def test_map_groups(rt):
+    ds = rd.from_items([{"g": i % 2, "v": i} for i in range(8)], parallelism=2)
+
+    def normalize(batch):
+        return [{"n": int(batch["v"].sum())}]
+
+    rows = ds.groupby("g").map_groups(normalize).take_all()
+    assert sorted(r["n"] for r in rows) == [12, 16]
+
+
+def test_union_zip(rt):
+    a = rd.range(5)
+    b = rd.range(5).map(lambda r: {"id2": r["id"] * 10})
+    assert a.union(rd.range(3)).count() == 8
+    z = a.zip(b).take_all()
+    assert z[2]["id"] == 2 and z[2]["id2"] == 20
+
+
+def test_split(rt):
+    shards = rd.range(100, parallelism=10).split(5)
+    assert len(shards) == 5
+    assert sum(s.count() for s in shards) == 100
+
+
+def test_split_equal(rt):
+    shards = rd.range(100, parallelism=3).split(4, equal=True)
+    counts = [s.count() for s in shards]
+    assert counts == [25, 25, 25, 25]
+
+
+def test_split_equal_drops_remainder(rt):
+    shards = rd.from_items([{"v": i} for i in range(11)]).split(3, equal=True)
+    assert [s.count() for s in shards] == [3, 3, 3]
+
+
+def test_groupby_single_block(rt):
+    ds = rd.from_items([{"g": i % 2, "v": i} for i in range(6)], parallelism=1)
+    rows = ds.groupby("g").sum("v").take_all()
+    assert {r["g"]: r["sum(v)"] for r in rows} == {0: 6, 1: 9}
+    assert rd.from_numpy(np.arange(5.0)).sum("data") == 10.0
+
+
+def test_repartition_single_block(rt):
+    ds = rd.from_items([{"id": i} for i in range(100)], parallelism=1)
+    assert ds.repartition(1).count() == 100
+    assert sorted(r["id"] for r in ds.repartition(1).take_all()) == list(
+        range(100)
+    )
+    shuffled = ds.random_shuffle(seed=3)
+    assert sorted(r["id"] for r in shuffled.take_all()) == list(range(100))
+
+
+def test_repartition_shuffle_true(rt):
+    ds = rd.range(100, parallelism=4).repartition(4, shuffle=True)
+    got = [r["id"] for r in ds.take_all()]
+    assert sorted(got) == list(range(100))
+    assert got != list(range(100))
+
+
+def test_streaming_split_multi_epoch(rt):
+    its = rd.range(32, parallelism=4).streaming_split(2)
+    for _epoch in range(3):
+        a = sum(len(b["id"]) for b in its[0].iter_batches(batch_size=8))
+        b = sum(len(b["id"]) for b in its[1].iter_batches(batch_size=8))
+        assert a + b == 32
+
+
+def test_zip_misaligned_blocks(rt):
+    a = rd.range(10, parallelism=1)
+    b = rd.range(10, parallelism=2).map(lambda r: {"id2": r["id"] * 10})
+    rows = a.zip(b).take_all()
+    assert len(rows) == 10
+    assert all(r["id2"] == r["id"] * 10 for r in rows)
+
+
+def test_zip_row_count_mismatch_raises(rt):
+    with pytest.raises(ValueError):
+        rd.range(10).zip(rd.range(7)).take_all()
+
+
+def test_no_fusion_across_pool_sizes(rt):
+    ds = (
+        rd.range(16, parallelism=2)
+        .map_batches(lambda b: {"x": b["id"]}, compute=1)
+        .map_batches(lambda b: {"x": b["x"] + 1}, compute=2)
+    )
+    assert sorted(r["x"] for r in ds.take_all()) == list(range(1, 17))
+
+
+def test_streaming_split(rt):
+    its = rd.range(64, parallelism=8).streaming_split(2)
+    a = list(its[0].iter_batches(batch_size=8, drop_last=False))
+    b = list(its[1].iter_batches(batch_size=8, drop_last=False))
+    rows = sum(len(x["id"]) for x in a) + sum(len(x["id"]) for x in b)
+    assert rows == 64
+
+
+def test_iter_batches_static_shapes(rt):
+    """TPU contract: all batches exactly batch_size when drop_last."""
+    batches = list(
+        rd.range(100).iter_batches(batch_size=32, drop_last=True)
+    )
+    assert len(batches) == 3
+    assert all(len(b["id"]) == 32 for b in batches)
+
+
+def test_iter_batches_local_shuffle(rt):
+    batches = list(
+        rd.range(50).iter_batches(
+            batch_size=10, local_shuffle_buffer_size=20, local_shuffle_seed=1
+        )
+    )
+    flat = [int(v) for b in batches for v in b["id"]]
+    assert sorted(flat) == list(range(50))
+    assert flat != list(range(50))
+
+
+def test_add_select_drop_rename_columns(rt):
+    ds = rd.range(10).add_column("twice", lambda b: b["id"] * 2)
+    row = ds.take(1)[0]
+    assert row["twice"] == 0
+    assert set(ds.select_columns(["twice"]).take(1)[0]) == {"twice"}
+    assert set(ds.drop_columns(["twice"]).take(1)[0]) == {"id"}
+    assert set(ds.rename_columns({"id": "idx"}).take(1)[0]) == {"idx", "twice"}
+
+
+def test_from_numpy_pandas_arrow(rt):
+    import pandas as pd
+    import pyarrow as pa
+
+    assert rd.from_numpy(np.ones((7, 2))).count() == 7
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert rd.from_pandas(df).count() == 3
+    t = pa.table({"a": [1, 2]})
+    assert rd.from_arrow(t).take_all() == [{"a": 1}, {"a": 2}]
+
+
+def test_parquet_roundtrip(rt, tmp_path):
+    ds = rd.range(25)
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out)
+    back = rd.read_parquet(out)
+    assert back.count() == 25
+    assert sorted(r["id"] for r in back.take_all()) == list(range(25))
+
+
+def test_csv_json_roundtrip(rt, tmp_path):
+    ds = rd.from_items([{"a": i, "b": float(i)} for i in range(10)])
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert rd.read_csv(csv_dir).count() == 10
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    assert rd.read_json(json_dir).count() == 10
+
+
+def test_read_text(rt, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("alpha\nbeta\n\ngamma\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+
+
+def test_train_test_split(rt):
+    train, test = rd.range(100).train_test_split(0.2)
+    assert train.count() == 80
+    assert test.count() == 20
+
+
+def test_compute_actors(rt):
+    ds = rd.range(32, parallelism=4).map_batches(
+        lambda b: {"x": b["id"] + 1}, compute=2
+    )
+    assert sorted(r["x"] for r in ds.take_all()) == list(range(1, 33))
+
+
+def test_range_tensor(rt):
+    ds = rd.range_tensor(8, shape=(2, 2))
+    batch = ds.take_batch(8)
+    assert batch["data"].shape == (8, 2, 2)
+
+
+def test_stats_populated(rt):
+    ds = rd.range(32).map_batches(lambda b: b)
+    ds.count()
+    assert "MapBatches" in ds.stats()
