@@ -38,6 +38,7 @@ from ray_tpu.data._internal.logical_plan import (
     MapBatches,
     MapRows,
     RandomShuffle,
+    RandomizeBlockOrder,
     Read,
     Repartition,
     Sort,
@@ -530,6 +531,13 @@ def execute_streaming(
             i += 1
         elif isinstance(op, RandomShuffle):
             stream = _random_shuffle(_materialize(stream), op.seed)
+            i += 1
+        elif isinstance(op, RandomizeBlockOrder):
+            import random as _random
+
+            bundles = _materialize(stream)
+            _random.Random(op.seed).shuffle(bundles)
+            stream = iter(bundles)
             i += 1
         elif isinstance(op, Sort):
             stream = _sort(_materialize(stream), op.key, op.descending)

@@ -110,6 +110,15 @@ class RandomShuffle(LogicalOp):
 
 
 @dataclass
+class RandomizeBlockOrder(LogicalOp):
+    """Cheap shuffle: permute block order only; lazy so each epoch (plan
+    re-execution) draws a fresh permutation when seed is None."""
+
+    seed: Optional[int] = None
+    name: str = "RandomizeBlockOrder"
+
+
+@dataclass
 class Sort(LogicalOp):
     key: Any
     descending: bool = False

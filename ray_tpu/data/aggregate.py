@@ -13,7 +13,21 @@ from typing import Any, Callable, Optional
 
 def _key_getter(on: Optional[str]) -> Callable[[Any], Any]:
     if on is None:
-        return lambda row: row
+        # Dict rows (the standard block row format): a single-column dataset
+        # aggregates over its only column; multi-column needs an explicit
+        # `on` (the reference aggregates every numeric column — here we ask
+        # the caller to pick one, which is unambiguous).
+        def get(row):
+            if isinstance(row, dict):
+                if len(row) == 1:
+                    return next(iter(row.values()))
+                raise ValueError(
+                    "Aggregation over a multi-column dataset requires "
+                    f"`on=<column>`; columns: {sorted(row)}"
+                )
+            return row
+
+        return get
     if callable(on):
         return on
     return lambda row: row[on]

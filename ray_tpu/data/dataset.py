@@ -29,6 +29,7 @@ from ray_tpu.data._internal.logical_plan import (
     MapBatches,
     MapRows,
     RandomShuffle,
+    RandomizeBlockOrder,
     Repartition,
     Sort,
     Union as UnionOp,
@@ -136,12 +137,9 @@ class Dataset:
 
     def randomize_block_order(self, *, seed: Optional[int] = None):
         """Cheap shuffle: permute block order only (reference
-        dataset.py randomize_block_order)."""
-        import random
-
-        bundles = self._materialize_bundles()
-        random.Random(seed).shuffle(bundles)
-        return _dataset_from_bundles(bundles)
+        dataset.py randomize_block_order). Lazy: with seed=None every plan
+        execution (epoch) draws a fresh permutation."""
+        return self._with_op(RandomizeBlockOrder(seed=seed))
 
     def sort(self, key=None, *, descending: bool = False):
         return self._with_op(Sort(key=key, descending=descending))
@@ -212,14 +210,11 @@ class Dataset:
         return [_dataset_from_bundles(s) for s in shards]
 
     def split_at_indices(self, indices: List[int]) -> List["MaterializedDataset"]:
-        rows = self.take_all()
-        out = []
-        prev = 0
-        for idx in list(indices) + [len(rows)]:
-            chunk = rows[prev:idx]
-            out.append(from_items_materialized(chunk))
-            prev = idx
-        return out
+        """Ref-level split: blocks are sliced only at boundaries; rows never
+        pass through the driver (reference dataset.py split_at_indices)."""
+        bundles = self._materialize_bundles()
+        shards = _split_at_row_indices(bundles, sorted(indices))
+        return [_dataset_from_bundles(s) for s in shards]
 
     def split_proportionately(self, proportions: List[float]):
         n = self.count()
@@ -434,6 +429,65 @@ class MaterializedDataset(Dataset):
         return sum(m.num_rows or 0 for _, m in self._bundles)
 
 
+def _split_at_row_indices(
+    bundles: List[RefBundle], boundaries: List[int]
+) -> List[List[RefBundle]]:
+    """Slice a bundle list at absolute row indices. Whole blocks are passed by
+    reference; blocks straddling a boundary are sliced once and re-put.
+    Returns len(boundaries)+1 shards."""
+
+    def put_slice(ref, block, start, end):
+        if block is None:
+            block = ray_tpu.get(ref)
+        piece = BlockAccessor.for_block(block).slice(start, end)
+        meta = BlockAccessor.for_block(piece).metadata()
+        return block, (ray_tpu.put(piece), meta)
+
+    shards: List[List[RefBundle]] = []
+    cur: List[RefBundle] = []
+    bi = 0
+    pos = 0  # absolute row index of the current block's start
+    for ref, meta in bundles:
+        n_rows = meta.num_rows or 0
+        block_cache = None
+        offset = 0
+        while offset < n_rows:
+            if bi >= len(boundaries):
+                # Tail shard takes everything remaining.
+                if offset == 0:
+                    cur.append((ref, meta))
+                else:
+                    block_cache, bundle = put_slice(ref, block_cache, offset, n_rows)
+                    cur.append(bundle)
+                offset = n_rows
+                continue
+            need = boundaries[bi] - (pos + offset)
+            if need <= 0:
+                shards.append(cur)
+                cur = []
+                bi += 1
+                continue
+            avail = n_rows - offset
+            if avail <= need:
+                if offset == 0:
+                    cur.append((ref, meta))
+                else:
+                    block_cache, bundle = put_slice(ref, block_cache, offset, n_rows)
+                    cur.append(bundle)
+                offset = n_rows
+            else:
+                block_cache, bundle = put_slice(
+                    ref, block_cache, offset, offset + need
+                )
+                cur.append(bundle)
+                offset += need
+        pos += n_rows
+    shards.append(cur)
+    while len(shards) < len(boundaries) + 1:
+        shards.append([])
+    return shards
+
+
 def _split_equal(bundles: List[RefBundle], n: int):
     """Split bundles into n exactly-equal shards of total//n rows each,
     slicing blocks at boundaries and DROPPING the remainder (the reference's
@@ -442,54 +496,8 @@ def _split_equal(bundles: List[RefBundle], n: int):
     per = rows_total // n
     if per == 0:
         return [[] for _ in range(n)]
-    shards: List[List[RefBundle]] = []
-    cur: List[RefBundle] = []
-    cur_rows = 0
-
-    def put_slice(ref, block, start, end):
-        nonlocal block_cache
-        if block is None:
-            block = ray_tpu.get(ref)
-        acc = BlockAccessor.for_block(block)
-        piece = acc.slice(start, end)
-        pa = BlockAccessor.for_block(piece)
-        return block, (ray_tpu.put(piece), pa.metadata())
-
-    block_cache = None
-    for ref, meta in bundles:
-        if len(shards) >= n:
-            break
-        block_cache = None
-        offset = 0
-        n_rows = meta.num_rows or 0
-        while offset < n_rows and len(shards) < n:
-            need = per - cur_rows
-            avail = n_rows - offset
-            if avail >= need:
-                if need == n_rows and offset == 0:
-                    cur.append((ref, meta))
-                elif need > 0:
-                    block_cache, bundle = put_slice(
-                        ref, block_cache, offset, offset + need
-                    )
-                    cur.append(bundle)
-                offset += need
-                shards.append(cur)
-                cur = []
-                cur_rows = 0
-            else:
-                if offset == 0:
-                    cur.append((ref, meta))
-                else:
-                    block_cache, bundle = put_slice(
-                        ref, block_cache, offset, n_rows
-                    )
-                    cur.append(bundle)
-                cur_rows += avail
-                offset = n_rows
-    while len(shards) < n:
-        shards.append([])
-    return shards
+    boundaries = [per * i for i in range(1, n + 1)]
+    return _split_at_row_indices(bundles, boundaries)[:n]
 
 
 def from_items_materialized(items: List[Any]) -> MaterializedDataset:

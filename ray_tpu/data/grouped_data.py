@@ -94,10 +94,18 @@ def _map_groups_task(key, fn, batch_format, *partials):
     for k in sorted(merged, key=lambda x: (x is None, x)):
         group = batch_to_format(merged[k], batch_format)
         out = fn(group)
-        if isinstance(out, list):
-            builder.add_batch(out)
-        else:
-            builder.add_batch(out)
+        if isinstance(out, dict):
+            # Allow scalar-valued dicts (one summary row per group) and
+            # list-valued columns: normalize to ndarray columns.
+            import numpy as np
+
+            out = {
+                col: np.asarray(
+                    v if hasattr(v, "__len__") and not isinstance(v, str) else [v]
+                )
+                for col, v in out.items()
+            }
+        builder.add_batch(out)
     block = builder.build()
     return block, BlockAccessor.for_block(block).metadata()
 
@@ -124,11 +132,13 @@ class GroupedData:
             )
             for ref, _ in bundles
         ]
-        out = []
+        # Submit every merge task before blocking on any metadata so the
+        # reduce side runs in parallel.
+        submitted = []
         for i in range(n_parts):
             shard = [p[i] if n_parts > 1 else p for p in parts]
-            ref, meta_ref = merge.remote(self._key, list(aggs), *shard)
-            out.append((ref, ray_tpu.get(meta_ref)))
+            submitted.append(merge.remote(self._key, list(aggs), *shard))
+        out = [(ref, ray_tpu.get(meta_ref)) for ref, meta_ref in submitted]
         return _dataset_from_bundles(out)
 
     def map_groups(self, fn: Callable, *, batch_format: str = "numpy"):
@@ -142,11 +152,11 @@ class GroupedData:
             group.options(num_returns=n_parts).remote(ref, self._key, n_parts)
             for ref, _ in bundles
         ]
-        out = []
+        submitted = []
         for i in range(n_parts):
             shard = [p[i] if n_parts > 1 else p for p in parts]
-            ref, meta_ref = apply.remote(self._key, fn, batch_format, *shard)
-            out.append((ref, ray_tpu.get(meta_ref)))
+            submitted.append(apply.remote(self._key, fn, batch_format, *shard))
+        out = [(ref, ray_tpu.get(meta_ref)) for ref, meta_ref in submitted]
         return _dataset_from_bundles(out)
 
     # -- sugar ----------------------------------------------------------

@@ -1,0 +1,46 @@
+"""ray_tpu.serve — online model serving.
+
+Re-design of the reference Serve library (python/ray/serve/): a central
+ServeController actor reconciles declarative deployment target state
+(serve/controller.py:79, _private/deployment_state.py:1115), replicas are
+plain actors, DeploymentHandles route requests to replicas client-side
+(_private/router.py:338,370), config changes fan out via a long-poll host
+(_private/long_poll.py:68,186), and replica counts autoscale on queue metrics
+(_private/autoscaling_policy.py:9,53).
+
+TPU-first departures from the reference:
+  * @serve.batch pads batches to bucketed sizes so a jitted model sees a
+    small, fixed set of shapes (XLA recompiles per shape; reference batching
+    serve/batching.py:242 has no such need on GPUs).
+  * Replicas hosting jitted callables warm their compile cache on init.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "status",
+]

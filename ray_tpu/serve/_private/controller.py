@@ -1,0 +1,325 @@
+"""ServeController actor: declarative reconciliation of deployment state.
+
+Reference: serve/controller.py:79 (ServeController; deploy_apps :483) +
+serve/_private/deployment_state.py:1115,2073 (DeploymentState/Manager; scaling
+:1493) + serve/_private/long_poll.py:68 (LongPollHost) +
+serve/_private/autoscaling_policy.py (queue-metric autoscaling).
+
+One controller actor per cluster (named, detached). A reconcile thread drives
+every deployment toward its target: start/stop replicas, apply user_config via
+reconfigure, health-check replicas, and autoscale on aggregate ongoing-request
+counts. Handles discover replicas through a versioned snapshot + blocking
+listen_for_change (long-poll)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+RECONCILE_PERIOD_S = 0.05
+
+
+class _DeploymentState:
+    def __init__(self, app: str, name: str, info: dict):
+        self.app = app
+        self.name = name
+        self.info = info  # callable_def, init_args, init_kwargs, config
+        self.replicas: dict[str, Any] = {}  # tag -> ActorHandle
+        self.replica_seq = 0
+        self.status = "UPDATING"
+        self.message = ""
+        self.last_autoscale: float = 0.0
+        # Queue depth reported by each handle (handle_id -> count).
+        self.handle_queued: dict[str, float] = {}
+        self.last_metrics: dict[str, int] = {}  # tag -> ongoing
+
+    @property
+    def key(self) -> str:
+        return f"{self.app}#{self.name}"
+
+    def target_replicas(self) -> int:
+        cfg = self.info["config"]
+        auto = cfg.autoscaling_config
+        if auto is None:
+            return cfg.num_replicas
+        total_ongoing = sum(self.last_metrics.values()) + sum(
+            self.handle_queued.values()
+        )
+        return auto.desired_replicas(total_ongoing, max(len(self.replicas), 1))
+
+
+class ServeControllerActor:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._apps: dict[str, dict[str, _DeploymentState]] = {}
+        self._version = 0
+        self._shutdown = False
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._reconcile_thread.start()
+
+    # ---------------- deploy / delete ----------------
+
+    def deploy_application(self, app_name: str, deployments: list[dict]) -> None:
+        """Set an application's target state (reference: controller.py:483
+        deploy_apps). Each dict: {name, callable_def, init_args, init_kwargs,
+        config}."""
+        with self._lock:
+            old = self._apps.get(app_name, {})
+            new: dict[str, _DeploymentState] = {}
+            for d in deployments:
+                name = d["name"]
+                existing = old.get(name)
+                if existing is not None and self._same_code(existing.info, d):
+                    # In-place update: keep replicas; reconcile applies config.
+                    existing.info = d
+                    existing.status = "UPDATING"
+                    new[name] = existing
+                    # Push new user_config to live replicas.
+                    if d["config"].user_config is not None:
+                        for h in list(existing.replicas.values()):
+                            try:
+                                h.reconfigure.remote(d["config"].user_config)
+                            except Exception:
+                                pass
+                else:
+                    if existing is not None:
+                        self._stop_all(existing)
+                    new[name] = _DeploymentState(app_name, name, d)
+            for name, st in old.items():
+                if name not in new:
+                    self._stop_all(st)
+            self._apps[app_name] = new
+            self._bump()
+
+    @staticmethod
+    def _same_code(old_info: dict, new_info: dict) -> bool:
+        return old_info.get("code_version") == new_info.get("code_version")
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if app:
+                for st in app.values():
+                    self._stop_all(st)
+            self._bump()
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            for app in self._apps.values():
+                for st in app.values():
+                    self._stop_all(st)
+            self._apps.clear()
+            self._shutdown = True
+            self._bump()
+
+    # ---------------- discovery (long poll) ----------------
+
+    def get_replica_snapshot(self, app: str, deployment: str) -> tuple[int, dict]:
+        """Returns (version, {replica_tag: ActorHandle, ...})."""
+        with self._lock:
+            st = self._get_state(app, deployment)
+            if st is None:
+                return self._version, {}
+            return self._version, dict(st.replicas)
+
+    def listen_for_change(self, known_version: int, timeout_s: float = 10.0):
+        """Block until cluster state version advances past known_version
+        (reference long-poll: serve/_private/long_poll.py:186)."""
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while self._version <= known_version and not self._shutdown:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            return self._version
+
+    def _bump(self) -> None:
+        self._version += 1
+        self._cv.notify_all()
+
+    # ---------------- metrics ----------------
+
+    def record_handle_metrics(
+        self, app: str, deployment: str, handle_id: str, queued: float
+    ) -> None:
+        with self._lock:
+            st = self._get_state(app, deployment)
+            if st is not None:
+                st.handle_queued[handle_id] = queued
+
+    # ---------------- status ----------------
+
+    def get_status(self) -> dict:
+        with self._lock:
+            out: dict[str, Any] = {}
+            for app_name, app in self._apps.items():
+                out[app_name] = {
+                    name: {
+                        "status": st.status,
+                        "message": st.message,
+                        "num_replicas": len(st.replicas),
+                        "target_replicas": st.target_replicas(),
+                    }
+                    for name, st in app.items()
+                }
+            return out
+
+    # ---------------- reconciliation ----------------
+
+    def _get_state(self, app: str, deployment: str) -> Optional[_DeploymentState]:
+        return self._apps.get(app, {}).get(deployment)
+
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                traceback.print_exc()
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = [
+                st for app in self._apps.values() for st in app.values()
+            ]
+        for st in states:
+            self._poll_metrics(st)
+            self._scale(st)
+
+    def _poll_metrics(self, st: _DeploymentState) -> None:
+        from ray_tpu import api as ray
+
+        refs = {}
+        with self._lock:
+            for tag, h in st.replicas.items():
+                try:
+                    refs[tag] = h.get_metrics.remote()
+                except Exception:
+                    pass
+        metrics = {}
+        for tag, ref in refs.items():
+            try:
+                m = ray.get(ref, timeout=2.0)
+                metrics[tag] = int(m["num_ongoing_requests"])
+            except Exception:
+                # Replica dead or unhealthy: drop it; scaling replaces it.
+                with self._lock:
+                    st.replicas.pop(tag, None)
+                    self._bump()
+        with self._lock:
+            st.last_metrics = metrics
+
+    def _scale(self, st: _DeploymentState) -> None:
+        from ray_tpu.api import kill
+        from ray_tpu.serve._private.replica import ReplicaActor
+        from ray_tpu.api import remote
+
+        with self._lock:
+            target = st.target_replicas()
+            current = len(st.replicas)
+            cfg = st.info["config"]
+            if current == target:
+                if st.status != "HEALTHY":
+                    st.status = "HEALTHY"
+                    self._bump()
+                return
+            if current < target:
+                to_start = target - current
+                specs = []
+                for _ in range(to_start):
+                    tag = f"{st.key}#{st.replica_seq}"
+                    st.replica_seq += 1
+                    specs.append(tag)
+            else:
+                # Scale down: prefer replicas with fewest ongoing requests.
+                order = sorted(
+                    st.replicas, key=lambda t: st.last_metrics.get(t, 0)
+                )
+                to_stop = order[: current - target]
+                for tag in to_stop:
+                    h = st.replicas.pop(tag)
+                    try:
+                        h.prepare_for_shutdown.remote()
+                        kill(h)
+                    except Exception:
+                        pass
+                self._bump()
+                return
+        # Start new replicas outside the lock (actor creation can be slow).
+        from ray_tpu.actor import ActorClass
+
+        replica_cls = ActorClass(
+            ReplicaActor,
+            {
+                "max_concurrency": max(2, cfg.max_concurrent_queries),
+                **cfg.ray_actor_options,
+            },
+        )
+        started = {}
+        for tag in specs:
+            try:
+                h = replica_cls.remote(
+                    st.name,
+                    tag,
+                    st.info["callable_def"],
+                    st.info["init_args"],
+                    st.info["init_kwargs"],
+                    cfg.user_config,
+                )
+                started[tag] = h
+            except Exception as e:
+                with self._lock:
+                    st.status = "DEPLOY_FAILED"
+                    st.message = str(e)
+                return
+        with self._lock:
+            st.replicas.update(started)
+            self._bump()
+
+    def _stop_all(self, st: _DeploymentState) -> None:
+        from ray_tpu.api import kill
+
+        for h in st.replicas.values():
+            try:
+                h.prepare_for_shutdown.remote()
+                kill(h)
+            except Exception:
+                pass
+        st.replicas.clear()
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def get_or_create_controller():
+    """Get the cluster's controller handle, starting it if needed."""
+    from ray_tpu import api as ray
+    from ray_tpu.actor import ActorClass
+
+    runtime = ray.get_runtime()
+    existing = runtime.controller.get_named_actor(
+        CONTROLLER_NAME, runtime.namespace
+    )
+    if existing is not None:
+        from ray_tpu.actor import ActorHandle
+
+        return ActorHandle(existing, "ServeControllerActor")
+    cls = ActorClass(
+        ServeControllerActor,
+        {
+            "name": CONTROLLER_NAME,
+            "get_if_exists": True,
+            "lifetime": "detached",
+            "max_concurrency": 64,
+        },
+    )
+    handle = cls.remote()
+    ray.get(handle.ping.remote(), timeout=30.0)
+    return handle

@@ -1,0 +1,90 @@
+"""HTTP ingress proxy.
+
+Reference: serve/_private/http_proxy.py:320,553 (HTTPProxyActor — a uvicorn
+ASGI server per node routing requests to deployment replicas through the same
+Router as handles). Here: a stdlib ThreadingHTTPServer inside an actor thread
+— requests POST JSON to /<app_name> (or / for the default app) and receive the
+ingress deployment's response as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._handles: dict[str, object] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                app_name = self.path.strip("/") or "default"
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b"null"
+                try:
+                    payload = json.loads(body)
+                except json.JSONDecodeError:
+                    payload = body.decode("utf-8", "replace")
+                try:
+                    handle = proxy._get_handle(app_name)
+                    result = handle.remote(payload).result(timeout_s=60.0)
+                    out = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            do_GET = do_POST
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+
+    def _get_handle(self, app_name: str):
+        handle = self._handles.get(app_name)
+        if handle is None:
+            from ray_tpu.serve.api import get_app_handle
+
+            handle = get_app_handle(app_name)
+            self._handles[app_name] = handle
+        return handle
+
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+_proxy: Optional[HTTPProxyActor] = None
+
+
+def start_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+    """Start (or return) the in-process HTTP proxy; returns (host, port)."""
+    global _proxy
+    if _proxy is None:
+        _proxy = HTTPProxyActor(host, port)
+    return _proxy.address()
+
+
+def stop_proxy() -> None:
+    global _proxy
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None

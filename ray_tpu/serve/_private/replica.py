@@ -1,0 +1,112 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Reference: serve/_private/replica.py (RayServeReplica.handle_request) — the
+replica is a plain actor; the router talks to it directly (CS5 in SURVEY.md).
+Concurrency comes from the actor's max_concurrency thread pool, bounded
+client-side by max_concurrent_queries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import time
+from typing import Any
+
+
+class ReplicaActor:
+    """One replica of a deployment.
+
+    Created by the controller with the user class/function (cloudpickled via
+    normal actor-arg serialization), init args, and user_config.
+    """
+
+    def __init__(
+        self,
+        deployment_name: str,
+        replica_tag: str,
+        callable_def: Any,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Any = None,
+    ):
+        self._deployment_name = deployment_name
+        self._replica_tag = replica_tag
+        self._lock = threading.Lock()
+        self._num_ongoing = 0
+        self._num_processed = 0
+        self._start_time = time.time()
+
+        if inspect.isclass(callable_def):
+            self._callable = callable_def(*init_args, **init_kwargs)
+        else:
+            # Function deployment: the "callable" is the function itself.
+            self._callable = callable_def
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any) -> None:
+        """Apply a new user_config without restarting (reference:
+        serve/_private/replica.py reconfigure → user class's `reconfigure`)."""
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            if inspect.isclass(type(self._callable)) and not inspect.isfunction(
+                self._callable
+            ):
+                # Classes receiving user_config must define reconfigure.
+                raise ValueError(
+                    f"Deployment {self._deployment_name} got user_config but "
+                    "its class defines no reconfigure() method"
+                )
+            return
+        result = fn(user_config)
+        if inspect.iscoroutine(result):
+            asyncio.run(result)
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        with self._lock:
+            self._num_ongoing += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+                self._num_processed += 1
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "replica_tag": self._replica_tag,
+                "num_ongoing_requests": self._num_ongoing,
+                "num_processed": self._num_processed,
+                "uptime_s": time.time() - self._start_time,
+            }
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            result = fn()
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return bool(result) if result is not None else True
+        return True
+
+    def prepare_for_shutdown(self) -> None:
+        fn = getattr(self._callable, "__del__", None)
+        # Graceful shutdown hook (reference: replica.py prepare_for_shutdown).
+        hook = getattr(self._callable, "shutdown", None)
+        if hook is not None:
+            try:
+                result = hook()
+                if inspect.iscoroutine(result):
+                    asyncio.run(result)
+            except Exception:
+                pass

@@ -1,0 +1,238 @@
+"""Public Serve API: @serve.deployment, .bind(), serve.run, status, shutdown.
+
+Reference: serve/api.py:413 (serve.run), serve/deployment.py (@serve.deployment
+→ Deployment → .bind() → Application), serve/_private/client.py:257
+(deploy_application). Applications are lazy graphs: bound deployments appearing
+in another deployment's init args are replaced with DeploymentHandles at
+deploy time (reference: deployment graph build,
+serve/_private/deployment_graph_build.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional, Union
+
+import cloudpickle
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclass
+class Application:
+    """A bound deployment (+ its transitively bound dependencies)."""
+
+    deployment: "Deployment"
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def _collect(self, out: dict) -> None:
+        name = self.deployment.name
+        if name in out:
+            return
+        out[name] = self
+        for a in list(self.init_args) + list(self.init_kwargs.values()):
+            if isinstance(a, Application):
+                a._collect(out)
+
+
+class Deployment:
+    def __init__(
+        self,
+        callable_def: Union[type, Callable],
+        name: str,
+        config: DeploymentConfig,
+    ):
+        self._callable_def = callable_def
+        self.name = name
+        self._config = config
+
+    def options(
+        self,
+        name: Optional[str] = None,
+        num_replicas: Optional[int] = None,
+        max_concurrent_queries: Optional[int] = None,
+        autoscaling_config: Optional[Union[AutoscalingConfig, dict]] = None,
+        user_config: Any = None,
+        ray_actor_options: Optional[dict] = None,
+    ) -> "Deployment":
+        cfg = replace(self._config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_concurrent_queries is not None:
+            cfg.max_concurrent_queries = max_concurrent_queries
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        return Deployment(self._callable_def, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def _code_version(self) -> str:
+        try:
+            payload = cloudpickle.dumps(self._callable_def)
+        except Exception:
+            payload = repr(self._callable_def).encode()
+        return hashlib.sha1(payload).hexdigest()[:16]
+
+
+def deployment(
+    _callable: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 100,
+    autoscaling_config: Optional[Union[AutoscalingConfig, dict]] = None,
+    user_config: Any = None,
+    ray_actor_options: Optional[dict] = None,
+):
+    """Decorator: mark a class or function as a Serve deployment."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options or {},
+        )
+        if autoscaling_config is not None:
+            ac = autoscaling_config
+            if isinstance(ac, dict):
+                ac = AutoscalingConfig(**ac)
+            cfg.autoscaling_config = ac
+        return Deployment(target, name or target.__name__, cfg)
+
+    if _callable is not None:
+        return wrap(_callable)
+    return wrap
+
+
+# ---------------- run / shutdown / status ----------------
+
+_DEFAULT_APP = "default"
+
+
+def run(
+    app: Application,
+    name: str = _DEFAULT_APP,
+    _blocking_timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application and block until healthy, returning a handle to
+    the ingress deployment (reference: serve/api.py:413)."""
+    from ray_tpu import api as ray
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    if not ray.is_initialized():
+        ray.init()
+    bound: dict[str, Application] = {}
+    app._collect(bound)
+
+    def materialize_arg(a):
+        if isinstance(a, Application):
+            d = a.deployment
+            return DeploymentHandle(
+                name, d.name, d._config.max_concurrent_queries
+            )
+        return a
+
+    deployments = []
+    for dep_name, bound_app in bound.items():
+        d = bound_app.deployment
+        deployments.append(
+            {
+                "name": dep_name,
+                "callable_def": d._callable_def,
+                "init_args": tuple(
+                    materialize_arg(a) for a in bound_app.init_args
+                ),
+                "init_kwargs": {
+                    k: materialize_arg(v)
+                    for k, v in bound_app.init_kwargs.items()
+                },
+                "config": d._config,
+                "code_version": d._code_version(),
+            }
+        )
+    controller = get_or_create_controller()
+    ray.get(controller.deploy_application.remote(name, deployments))
+    _wait_healthy(controller, name, _blocking_timeout_s)
+    ingress = app.deployment
+    return DeploymentHandle(
+        name, ingress.name, ingress._config.max_concurrent_queries
+    )
+
+
+def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
+    import time
+
+    from ray_tpu import api as ray
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        st = ray.get(controller.get_status.remote())
+        app = st.get(app_name, {})
+        if app and all(d["status"] == "HEALTHY" for d in app.values()):
+            return
+        if any(d["status"] == "DEPLOY_FAILED" for d in app.values()):
+            bad = {k: v for k, v in app.items() if v["status"] == "DEPLOY_FAILED"}
+            raise RuntimeError(f"Deployment failed: {bad}")
+        time.sleep(0.05)
+    raise TimeoutError(f"Application {app_name!r} not healthy in {timeout_s}s")
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = _DEFAULT_APP
+) -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def get_app_handle(app_name: str = _DEFAULT_APP) -> DeploymentHandle:
+    from ray_tpu import api as ray
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    st = ray.get(get_or_create_controller().get_status.remote())
+    app = st.get(app_name)
+    if not app:
+        raise ValueError(f"No application named {app_name!r}")
+    # The ingress is the first deployment deployed for the app.
+    return DeploymentHandle(app_name, next(iter(app)))
+
+
+def status() -> dict:
+    from ray_tpu import api as ray
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    return ray.get(get_or_create_controller().get_status.remote())
+
+
+def shutdown() -> None:
+    from ray_tpu import api as ray
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.serve._private.controller import (
+        CONTROLLER_NAME,
+        get_or_create_controller,
+    )
+
+    if not ray.is_initialized():
+        return
+    runtime = get_runtime()
+    existing = runtime.controller.get_named_actor(
+        CONTROLLER_NAME, runtime.namespace
+    )
+    if existing is None:
+        return
+    controller = get_or_create_controller()
+    try:
+        ray.get(controller.graceful_shutdown.remote(), timeout=30.0)
+    finally:
+        from ray_tpu.actor import ActorHandle
+
+        ray.kill(ActorHandle(existing, "ServeControllerActor"))

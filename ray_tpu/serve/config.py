@@ -1,0 +1,60 @@
+"""Deployment + autoscaling config schemas.
+
+Reference: serve/config.py (DeploymentConfig), serve/_private/autoscaling_policy.py
+and serve/schema.py (declarative REST schema).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-depth driven replica autoscaling (reference:
+    serve/_private/autoscaling_policy.py:9 calculate_desired_num_replicas)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+    metrics_interval_s: float = 0.1
+    look_back_period_s: float = 2.0
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(self.min_replicas, 1 if total_ongoing > 0 else 0)
+        per_replica = total_ongoing / current
+        error_ratio = per_replica / max(
+            self.target_num_ongoing_requests_per_replica, 1e-9
+        )
+        smoothing = (
+            self.upscale_smoothing_factor
+            if error_ratio > 1
+            else self.downscale_smoothing_factor
+        )
+        desired = current * (1.0 + (error_ratio - 1.0) * smoothing)
+        import math
+
+        desired = math.ceil(desired - 1e-9)
+        return max(self.min_replicas, min(self.max_replicas, desired))
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment target config (reference: serve/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Any = None
+    ray_actor_options: dict = field(default_factory=dict)
+    health_check_period_s: float = 1.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 0)
+        return self.num_replicas

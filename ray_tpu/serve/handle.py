@@ -1,0 +1,220 @@
+"""DeploymentHandle + client-side Router.
+
+Reference: serve/handle.py:74 (RayServeHandle), serve/_private/router.py:338,
+370 (Router.assign_replica: pick a replica with < max_concurrent_queries in
+flight, block otherwise) and the LongPollClient (_private/long_poll.py:68)
+keeping the replica set fresh without polling per-request.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime import get_runtime
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef (reference:
+    serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref: ObjectRef, router: "Router", replica_tag: str):
+        self._ref = ref
+        self._router = router
+        self._replica_tag = replica_tag
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        from ray_tpu import api as ray
+
+        try:
+            value = ray.get(self._ref, timeout=timeout_s)
+        finally:
+            self._settle()
+        return value
+
+    def _to_object_ref(self) -> ObjectRef:
+        return self._ref
+
+    def _settle(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router._on_done(self._replica_tag)
+
+
+class Router:
+    """Client-side replica selection: power-of-two-choices over in-flight
+    counts, respecting max_concurrent_queries (reference router.py:338-367
+    blocks awaiting a free replica or a config update)."""
+
+    METRICS_PUSH_PERIOD_S = 0.25
+
+    def __init__(self, app: str, deployment: str, max_concurrent_queries: int):
+        self._app = app
+        self._deployment = deployment
+        self._max_q = max_concurrent_queries
+        self._handle_id = uuid.uuid4().hex[:12]
+        self._lock = threading.Condition()
+        self._replicas: dict[str, Any] = {}
+        self._in_flight: dict[str, int] = {}
+        self._version = -1
+        self._queued = 0
+        self._closed = False
+        self._refresh()
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True, name=f"router-{deployment}"
+        )
+        self._poller.start()
+
+    # ---------------- replica set maintenance ----------------
+
+    def _controller(self):
+        from ray_tpu.serve._private.controller import get_or_create_controller
+
+        return get_or_create_controller()
+
+    def _refresh(self) -> None:
+        from ray_tpu import api as ray
+
+        version, replicas = ray.get(
+            self._controller().get_replica_snapshot.remote(
+                self._app, self._deployment
+            )
+        )
+        with self._lock:
+            self._version = version
+            self._replicas = replicas
+            for tag in replicas:
+                self._in_flight.setdefault(tag, 0)
+            for tag in list(self._in_flight):
+                if tag not in replicas:
+                    del self._in_flight[tag]
+            self._lock.notify_all()
+
+    def _poll_loop(self) -> None:
+        from ray_tpu import api as ray
+
+        last_push = 0.0
+        while not self._closed:
+            try:
+                new_version = ray.get(
+                    self._controller().listen_for_change.remote(
+                        self._version, 1.0
+                    ),
+                    timeout=5.0,
+                )
+                if new_version != self._version:
+                    self._refresh()
+                now = time.time()
+                if now - last_push > self.METRICS_PUSH_PERIOD_S:
+                    with self._lock:
+                        queued = self._queued + sum(self._in_flight.values())
+                    self._controller().record_handle_metrics.remote(
+                        self._app, self._deployment, self._handle_id, queued
+                    )
+                    last_push = now
+            except Exception:
+                if self._closed:
+                    return
+                time.sleep(0.2)
+
+    # ---------------- request path ----------------
+
+    def assign(self, method_name: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+        with self._lock:
+            self._queued += 1
+        try:
+            tag, handle = self._pick_replica()
+        finally:
+            with self._lock:
+                self._queued -= 1
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        return DeploymentResponse(ref, self, tag)
+
+    def _pick_replica(self, timeout_s: float = 30.0):
+        deadline = time.time() + timeout_s
+        with self._lock:
+            while True:
+                candidates = [
+                    (tag, h)
+                    for tag, h in self._replicas.items()
+                    if self._in_flight.get(tag, 0) < self._max_q
+                ]
+                if candidates:
+                    if len(candidates) > 2:
+                        candidates = random.sample(candidates, 2)
+                    tag, h = min(
+                        candidates, key=lambda th: self._in_flight.get(th[0], 0)
+                    )
+                    self._in_flight[tag] = self._in_flight.get(tag, 0) + 1
+                    return tag, h
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"No available replica for {self._deployment} within "
+                        f"{timeout_s}s"
+                    )
+                self._lock.wait(min(remaining, 0.5))
+
+    def _on_done(self, tag: str) -> None:
+        with self._lock:
+            if tag in self._in_flight and self._in_flight[tag] > 0:
+                self._in_flight[tag] -= 1
+            self._lock.notify_all()
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class DeploymentHandle:
+    """User-facing handle: `handle.remote(...)` / `handle.method.remote(...)`
+    (reference: serve/handle.py:74)."""
+
+    def __init__(
+        self,
+        app: str,
+        deployment: str,
+        max_concurrent_queries: int = 100,
+        method_name: str = "__call__",
+        _router: Optional[Router] = None,
+    ):
+        self._app = app
+        self._deployment = deployment
+        self._max_q = max_concurrent_queries
+        self._method_name = method_name
+        self._router = _router
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self._app, self._deployment, self._max_q)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._get_router().assign(self._method_name, args, kwargs)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._app, self._deployment, self._max_q, method_name,
+            _router=self._router,
+        )
+        return h
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(method_name=item)
+
+    def __reduce__(self):
+        # Handles are serializable into replicas/tasks; router rebuilds lazily.
+        return (
+            DeploymentHandle,
+            (self._app, self._deployment, self._max_q, self._method_name),
+        )
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._app}#{self._deployment})"

@@ -93,12 +93,21 @@ class DataParallelTrainer:
     def _dataset_shard_fn(self, rank: int, world_size: int) -> Optional[dict]:
         if not self._datasets:
             return None
+        # streaming_split iterators share ONE coordinator: build the split
+        # once per (dataset, world_size) and hand rank-th iterators out.
+        # Per-rank splits would each spawn a coordinator whose other n-1
+        # queues nobody drains — the feeder blocks and training hangs.
+        cache = getattr(self, "_split_cache", None)
+        if cache is None:
+            cache = self._split_cache = {}
         shards = {}
         for name, ds in self._datasets.items():
             split = getattr(ds, "streaming_split", None)
             if split is not None:
-                # ray_tpu.data.Dataset: per-worker streaming shard.
-                shards[name] = ds.streaming_split(world_size)[rank]
+                key = (name, world_size)
+                if key not in cache:
+                    cache[key] = ds.streaming_split(world_size)
+                shards[name] = cache[key][rank]
             elif isinstance(ds, (list, tuple)):
                 shards[name] = ds[rank::world_size]
             else:
@@ -121,6 +130,9 @@ class DataParallelTrainer:
         try:
             while True:
                 try:
+                    # Fresh split coordinators per attempt: after a worker
+                    # failure the old iterators are mid-stream/exhausted.
+                    self._split_cache = {}
                     self._run_training(executor, ckpt_manager, history)
                     break
                 except TrainingWorkerError as exc:

@@ -288,3 +288,87 @@ def test_stats_populated(rt):
     ds = rd.range(32).map_batches(lambda b: b)
     ds.count()
     assert "MapBatches" in ds.stats()
+
+
+def test_sum_mean_single_column_no_on(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(5)
+    assert ds.sum() == 10
+    assert ds.mean() == 2.0
+
+
+def test_aggregate_multi_column_requires_on(ray_start_regular):
+    import pytest
+
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"a": 1, "b": 2}, {"a": 3, "b": 4}])
+    assert ds.sum(on="a") == 4
+    with pytest.raises(Exception, match="on"):
+        ds.sum()
+
+
+def test_split_at_indices_ref_level(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(10, parallelism=3)
+    a, b, c = ds.split_at_indices([3, 7])
+    assert [r["id"] for r in a.take_all()] == [0, 1, 2]
+    assert [r["id"] for r in b.take_all()] == [3, 4, 5, 6]
+    assert [r["id"] for r in c.take_all()] == [7, 8, 9]
+
+
+def test_split_at_indices_out_of_range(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(4, parallelism=2)
+    parts = ds.split_at_indices([2, 10])
+    assert [len(p.take_all()) for p in parts] == [2, 2, 0]
+
+
+def test_randomize_block_order_is_lazy_and_fresh_per_epoch(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.range(64, parallelism=16).randomize_block_order()
+    orders = set()
+    for _ in range(5):
+        orders.add(tuple(r["id"] for r in ds.take_all()))
+    # With 16 blocks, 5 independent permutations virtually never all collide.
+    assert len(orders) > 1
+    # Seeded: deterministic.
+    ds2 = rd.range(64, parallelism=16).randomize_block_order(seed=7)
+    assert [r["id"] for r in ds2.take_all()] == [r["id"] for r in ds2.take_all()]
+
+
+def test_map_groups_scalar_dict_return(ray_start_regular):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(6)])
+    out = ds.groupby("k").map_groups(
+        lambda g: {"k": int(g["k"][0]), "n": len(g["v"])}
+    )
+    rows = sorted(out.take_all(), key=lambda r: r["k"])
+    assert rows == [{"k": 0, "n": 3}, {"k": 1, "n": 3}]
+
+
+def test_streaming_split_many_blocks_shared_coordinator(ray_start_regular):
+    """Regression: per-rank coordinators deadlock once queues fill (>8 blocks)."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(200, parallelism=25)
+    it0, it1 = ds.streaming_split(2)
+    seen = []
+
+    import threading
+
+    def consume(it):
+        local = [r["id"] for r in it.iter_rows()]
+        seen.append(local)
+
+    t0 = threading.Thread(target=consume, args=(it0,))
+    t1 = threading.Thread(target=consume, args=(it1,))
+    t0.start(); t1.start()
+    t0.join(timeout=60); t1.join(timeout=60)
+    assert not t0.is_alive() and not t1.is_alive(), "streaming_split deadlocked"
+    assert sorted(seen[0] + seen[1]) == list(range(200))

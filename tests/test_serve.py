@@ -1,0 +1,235 @@
+"""Serve library tests (reference test strategy: serve/tests/)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind())
+    assert handle.remote("hi").result() == {"echo": "hi"}
+
+
+def test_class_deployment_and_methods(serve_instance):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+        def peek(self):
+            return self.count
+
+    handle = serve.run(Counter.bind(10))
+    assert handle.remote(5).result() == 15
+    assert handle.peek.remote().result() == 15
+
+
+def test_multi_replica_round_robin(serve_instance):
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            self.id = id(self)
+
+        def __call__(self, _):
+            return self.id
+
+    handle = serve.run(WhoAmI.bind())
+    seen = {handle.remote(None).result() for _ in range(30)}
+    assert len(seen) == 3
+
+
+def test_composed_deployments(serve_instance):
+    @serve.deployment
+    class Downstream:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, downstream):
+            self.downstream = downstream
+
+        def __call__(self, x):
+            return self.downstream.remote(x).result() + 1
+
+    handle = serve.run(Ingress.bind(Downstream.bind()))
+    assert handle.remote(10).result() == 21
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 1})
+    class Model:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Model.bind())
+    assert handle.remote(None).result() == 1
+    # Redeploy with new user_config — same code version → in-place reconfigure.
+    serve.run(Model.options(user_config={"threshold": 7}).bind())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if handle.remote(None).result() == 7:
+            break
+        time.sleep(0.1)
+    assert handle.remote(None).result() == 7
+
+
+def test_autoscaling_scales_up_and_down(serve_instance):
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_num_ongoing_requests_per_replica": 1,
+        },
+        max_concurrent_queries=2,
+    )
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.4)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    st = serve.status()["default"]["Slow"]
+    assert st["num_replicas"] == 1
+
+    results = []
+
+    def fire():
+        results.append(handle.remote(None).result(timeout_s=30))
+
+    threads = [threading.Thread(target=fire) for _ in range(12)]
+    for t in threads:
+        t.start()
+    # While load is in flight, replicas should grow past 1.
+    grew = False
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if serve.status()["default"]["Slow"]["num_replicas"] > 1:
+            grew = True
+            break
+        time.sleep(0.05)
+    for t in threads:
+        t.join()
+    assert grew
+    assert len(results) == 12
+    # After load drains, scale back toward min_replicas.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if serve.status()["default"]["Slow"]["num_replicas"] == 1:
+            break
+        time.sleep(0.1)
+    assert serve.status()["default"]["Slow"]["num_replicas"] == 1
+
+
+def test_batching(serve_instance):
+    batch_sizes = []
+
+    @serve.deployment(max_concurrent_queries=32)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def __call__(self, items):
+            batch_sizes.append(len(items))
+            return [x + 1 for x in items]
+
+    handle = serve.run(Batched.bind())
+    results = []
+
+    def fire(i):
+        results.append(handle.remote(i).result(timeout_s=30))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == list(range(1, 9))
+
+
+def test_batch_pad_to_bucket():
+    from ray_tpu.serve.batching import _next_bucket
+
+    assert _next_bucket(3, 8) == 4
+    assert _next_bucket(5, 8) == 8
+    assert _next_bucket(9, 8) == 8
+    calls = []
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05, pad_to_bucket=True)
+    def process(items):
+        calls.append(len(items))
+        return [x * 2 for x in items]
+
+    out = []
+    threads = [
+        threading.Thread(target=lambda i=i: out.append(process(i)))
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(out) == [0, 2, 4]
+    # Batch was padded to a power-of-two bucket.
+    assert all(c in (1, 2, 4, 8) for c in calls)
+
+
+def test_status_and_shutdown(serve_instance):
+    @serve.deployment
+    def f(x):
+        return x
+
+    serve.run(f.bind(), name="app1")
+    st = serve.status()
+    assert st["app1"]["f"]["status"] == "HEALTHY"
+    serve.shutdown()
+    # A fresh controller comes up empty.
+    assert serve.status() == {}
+
+
+def test_http_proxy(serve_instance):
+    from ray_tpu.serve._private.http_proxy import start_proxy, stop_proxy
+
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    serve.run(double.bind())
+    host, port = start_proxy()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/default",
+            data=json.dumps(21).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["result"] == 42
+    finally:
+        stop_proxy()
