@@ -26,6 +26,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"
@@ -34,6 +35,7 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "available_resources",
     "cancel",

@@ -66,6 +66,52 @@ def _run_callable(fn: Callable, args: tuple, kwargs: dict) -> TaskResult:
         return TaskResult(exc=exc, traceback_str=traceback.format_exc())
 
 
+def _maybe_consume_stream(spec: TaskSpec, result: TaskResult) -> TaskResult:
+    """For streaming tasks whose function returned a generator: drive it on
+    this worker thread (resources stay held), sealing each yielded item as its
+    own object via the owner (reference: execute_task's generator path,
+    _raylet.pyx:1293 + ReportGeneratorItemReturns). The completion value is
+    the item count; mid-generator errors become the failing item."""
+    if not spec.streaming or result.exc is not None:
+        return result
+    gen = result.value
+    if not inspect.isgenerator(gen):
+        # A streaming task returning a plain value: one-item stream.
+        gen = iter([gen] if gen is not None else [])
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    i = 0
+    try:
+        for item in gen:
+            runtime.report_stream_item(spec, i, value=item)
+            i += 1
+    except BaseException as exc:  # noqa: BLE001
+        runtime.report_stream_item(
+            spec, i, error=exc, traceback_str=traceback.format_exc()
+        )
+        i += 1
+    return TaskResult(value=i)
+
+
+async def _consume_async_stream(spec: TaskSpec, agen) -> TaskResult:
+    """Async-generator variant of _maybe_consume_stream for async actors."""
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    i = 0
+    try:
+        async for item in agen:
+            runtime.report_stream_item(spec, i, value=item)
+            i += 1
+    except BaseException as exc:  # noqa: BLE001
+        runtime.report_stream_item(
+            spec, i, error=exc, traceback_str=traceback.format_exc()
+        )
+        i += 1
+    return TaskResult(value=i)
+
+
 class NodeEngine:
     """Runs normal tasks and hosts actors for one logical node."""
 
@@ -103,6 +149,7 @@ class NodeEngine:
                 self._on_task_done(spec, self.node, grant, TaskResult(exc=exc))
                 return
             result = _run_callable(spec.func, args, kwargs)
+            result = _maybe_consume_stream(spec, result)
             self._on_task_done(spec, self.node, grant, result)
 
         self._pool.submit(run)
@@ -290,11 +337,16 @@ class ActorExecutor:
                 try:
                     args, kwargs = self._resolve_args(spec)
                     method = getattr(self.instance, spec.method_name)
-                    if inspect.iscoroutinefunction(method):
-                        value = await method(*args, **kwargs)
+                    if inspect.isasyncgenfunction(method) and spec.streaming:
+                        result = await _consume_async_stream(
+                            spec, method(*args, **kwargs)
+                        )
                     else:
-                        value = method(*args, **kwargs)
-                    result = TaskResult(value=value)
+                        if inspect.iscoroutinefunction(method):
+                            value = await method(*args, **kwargs)
+                        else:
+                            value = method(*args, **kwargs)
+                        result = _maybe_consume_stream(spec, TaskResult(value=value))
                 except BaseException as exc:  # noqa: BLE001
                     result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
                 self._on_task_done(spec, self.node.node, {}, result)
@@ -321,6 +373,7 @@ class ActorExecutor:
             args, kwargs = self._resolve_args(spec)
             method = getattr(self.instance, spec.method_name)
             result = _run_callable(method, args, kwargs)
+            result = _maybe_consume_stream(spec, result)
         except BaseException as exc:  # noqa: BLE001
             result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
         self._on_task_done(spec, self.node.node, {}, result)

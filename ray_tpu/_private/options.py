@@ -64,8 +64,13 @@ def _validate(opts: dict[str, Any], valid: set, kind: str) -> dict[str, Any]:
         if key in ("num_cpus", "num_gpus", "num_tpus") and value is not None:
             if value < 0:
                 raise ValueError(f"{key} must be >= 0, got {value}")
-        if key == "num_returns" and (not isinstance(value, int) or value < 0):
-            raise ValueError(f"num_returns must be a non-negative int, got {value}")
+        if key == "num_returns" and value != "streaming" and (
+            not isinstance(value, int) or value < 0
+        ):
+            raise ValueError(
+                "num_returns must be a non-negative int or 'streaming', "
+                f"got {value}"
+            )
         if key in ("max_retries", "max_restarts") and value < -1:
             raise ValueError(f"{key} must be >= -1, got {value}")
         if key == "resources" and value:

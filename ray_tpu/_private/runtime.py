@@ -59,6 +59,8 @@ from ray_tpu.exceptions import (
 
 _RUNTIME: Optional["Runtime"] = None
 _PUT_INDEX_OFFSET = 1 << 20  # puts live above return indices in the ObjectID space
+_STREAM_INDEX_OFFSET = 1 << 19  # streaming-generator items live below puts
+_STREAM_ERROR_INDEX = (1 << 19) - 1  # slot for pre-generator failures
 
 
 class ErrorObject:
@@ -157,6 +159,7 @@ class Runtime:
         self._actor_specs: dict[ActorID, TaskSpec] = {}
         self._actor_grants: dict[ActorID, tuple[NodeID, dict[str, float]]] = {}
         self._task_records: dict[TaskID, _TaskRecord] = {}
+        self._streams: dict[TaskID, Any] = {}
         self._background = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu-bg"
         )
@@ -298,6 +301,7 @@ class Runtime:
         max_retries: int,
         retry_exceptions: Any,
     ) -> list[ObjectRef]:
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=self._new_task_id(),
             job_id=self.job_id,
@@ -306,10 +310,13 @@ class Runtime:
             func=func,
             args=args,
             kwargs=dict(kwargs),
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
+            streaming=streaming,
             resources=resources,
             scheduling_strategy=scheduling_strategy,
-            max_retries=max_retries,
+            # Streaming tasks are not retried: items already consumed can't be
+            # un-yielded (reference dedups by item index; out of scope here).
+            max_retries=0 if streaming else max_retries,
             retry_exceptions=retry_exceptions,
             parent_task_id=self.current_task_id(),
         )
@@ -320,8 +327,75 @@ class Runtime:
             refs.append(ObjectRef(oid))
         with self._lock:
             self._task_records[spec.task_id] = _TaskRecord(spec, resources)
+        if streaming:
+            gen = self._register_stream(spec, completion_ref=refs[0])
+            self._submit_when_ready(spec, resources)
+            return [gen]
         self._submit_when_ready(spec, resources)
         return refs
+
+    # ------------------------------------------------------- streaming gens
+
+    def _register_stream(self, spec: TaskSpec, completion_ref: ObjectRef):
+        """Create the owner-side ObjectRefStream for a streaming task
+        (reference: TaskManager ObjectRefStream, task_manager.h:100)."""
+        from ray_tpu._private.streaming import ObjectRefGenerator, ObjectRefStream
+
+        stream = ObjectRefStream()
+        with self._lock:
+            self._streams[spec.task_id] = stream
+        gen = ObjectRefGenerator(stream, spec.task_id)
+        # The completion object's lifetime rides on the generator handle.
+        gen._completion_ref = completion_ref
+        return gen
+
+    def report_stream_item(
+        self,
+        spec: TaskSpec,
+        index: int,
+        value: Any = None,
+        error: Optional[BaseException] = None,
+        traceback_str: str = "",
+    ) -> None:
+        """Seal one yielded item and hand its ref to the consumer (reference:
+        CoreWorker::ReportGeneratorItemReturns, core_worker.h:770)."""
+        with self._lock:
+            stream = self._streams.get(spec.task_id)
+        oid = ObjectID.of(spec.task_id, _STREAM_INDEX_OFFSET + index)
+        self.refcount.add_owned_object(oid, owner_task=spec.task_id)
+        ref = ObjectRef(oid)
+        if error is not None:
+            exc = error
+            if not isinstance(
+                exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)
+            ):
+                exc = TaskError(exc, traceback_str, spec.name)
+            self.store.seal(oid, ErrorObject(exc, traceback_str))
+        else:
+            self.store.seal(oid, value)
+        if stream is not None:
+            stream.offer(ref)
+
+    def _finish_stream(self, spec: TaskSpec, result: TaskResult) -> None:
+        with self._lock:
+            stream = self._streams.pop(spec.task_id, None)
+        if stream is None:
+            return
+        if result.exc is not None:
+            # Failure before the generator produced (bad args, actor death):
+            # surface it as the stream's last item so iteration raises.
+            exc = result.exc
+            if not isinstance(
+                exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)
+            ):
+                exc = TaskError(exc, result.traceback_str, spec.name)
+            oid = ObjectID.of(spec.task_id, _STREAM_INDEX_OFFSET + _STREAM_ERROR_INDEX)
+            self.refcount.add_owned_object(oid, owner_task=spec.task_id)
+            ref = ObjectRef(oid)
+            self.store.seal(oid, ErrorObject(exc, result.traceback_str))
+            stream.offer(ref)
+        total = result.value if isinstance(result.value, int) else 0
+        stream.finish(total)
 
     def _submit_when_ready(self, spec: TaskSpec, request: dict[str, float]) -> None:
         """Hold args alive for this attempt, then queue once deps are sealed
@@ -412,6 +486,7 @@ class Runtime:
         if record is None:
             raise ValueError(f"Unknown actor {actor_id}")
         creation = self._actor_specs.get(actor_id)
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
             job_id=self.job_id,
@@ -420,10 +495,11 @@ class Runtime:
             method_name=method_name,
             args=args,
             kwargs=dict(kwargs),
-            num_returns=num_returns,
+            num_returns=1 if streaming else num_returns,
+            streaming=streaming,
             resources={},
             actor_id=actor_id,
-            max_retries=creation.max_task_retries if creation else 0,
+            max_retries=0 if streaming else (creation.max_task_retries if creation else 0),
             retry_exceptions=False,
             parent_task_id=self.current_task_id(),
         )
@@ -434,6 +510,10 @@ class Runtime:
             refs.append(ObjectRef(oid))
         with self._lock:
             self._task_records[spec.task_id] = _TaskRecord(spec, {})
+        if streaming:
+            gen = self._register_stream(spec, completion_ref=refs[0])
+            self._enqueue_actor_task_when_ready(spec)
+            return [gen]
         self._enqueue_actor_task_when_ready(spec)
         return refs
 
@@ -630,6 +710,8 @@ class Runtime:
                 self.scheduler.notify()
                 return
         self._finalize(spec, result, already_decrefed=True)
+        if spec.streaming:
+            self._finish_stream(spec, result)
         if spec.kind == TaskKind.ACTOR_CREATION:
             actor_record = self.controller.get_actor_record(spec.actor_id)
             if result.exc is None:
@@ -684,12 +766,18 @@ class Runtime:
         if retry:
             self._submit_when_ready(record.spec, record.request)
         else:
-            self._finalize(record.spec, TaskResult(exc=exc))
+            result = TaskResult(exc=exc)
+            self._finalize(record.spec, result)
+            if record.spec.streaming:
+                self._finish_stream(record.spec, result)
 
     def _fail_unscheduled(self, spec: TaskSpec, exc: BaseException) -> None:
         """Scheduler could not place the task (infeasible / bad PG)."""
         self.refcount.update_finished_task_references(self._dep_ids(spec))
-        self._finalize(spec, TaskResult(exc=exc), already_decrefed=True)
+        result = TaskResult(exc=exc)
+        self._finalize(spec, result, already_decrefed=True)
+        if spec.streaming:
+            self._finish_stream(spec, result)
 
     def _finalize(
         self, spec: TaskSpec, result: TaskResult, already_decrefed: bool = False

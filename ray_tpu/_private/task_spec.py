@@ -33,6 +33,7 @@ class TaskSpec:
     args: tuple = ()
     kwargs: dict = field(default_factory=dict)
     num_returns: int = 1
+    streaming: bool = False  # num_returns="streaming": generator task
     resources: dict[str, float] = field(default_factory=dict)
     scheduling_strategy: Any = None
     max_retries: int = 0

@@ -38,7 +38,7 @@ class ActorMethod:
         )
         if self._num_returns == 0:
             return None
-        if self._num_returns == 1:
+        if self._num_returns == 1 or self._num_returns == "streaming":
             return refs[0]
         return refs
 
@@ -151,6 +151,12 @@ class ActorClass:
         return ActorHandle(
             actor_id, self._cls.__name__, creation_ref, method_num_returns
         )
+
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor-creation DAG node (reference: dag/class_node.py)."""
+        from ray_tpu.dag.dag_node import ClassNode
+
+        return ClassNode(self, args, kwargs, {})
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -44,9 +44,16 @@ class RemoteFunction:
         )
         if num_returns == 0:
             return None
-        if num_returns == 1:
+        if num_returns == 1 or num_returns == "streaming":
             return refs[0]
         return refs
+
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (reference:
+        dag/function_node.py)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs, {})
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -22,6 +22,26 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 RECONCILE_PERIOD_S = 0.05
 
 
+def _stop_replica_gracefully(handle, timeout_s: float) -> None:
+    """Run the replica's shutdown hook, THEN kill — off-thread so the
+    reconcile loop never blocks on user cleanup code (reference:
+    deployment_state.py graceful shutdown with graceful_shutdown_timeout_s)."""
+
+    def stop():
+        from ray_tpu import api as ray
+
+        try:
+            ray.get(handle.prepare_for_shutdown.remote(), timeout=timeout_s)
+        except Exception:
+            pass
+        try:
+            ray.kill(handle)
+        except Exception:
+            pass
+
+    threading.Thread(target=stop, daemon=True, name="serve-replica-stop").start()
+
+
 class _DeploymentState:
     def __init__(self, app: str, name: str, info: dict):
         self.app = app
@@ -203,24 +223,30 @@ class ServeControllerActor:
                     refs[tag] = h.get_metrics.remote()
                 except Exception:
                     pass
+        from ray_tpu.exceptions import ActorDiedError
+
         metrics = {}
         for tag, ref in refs.items():
             try:
                 m = ray.get(ref, timeout=2.0)
                 metrics[tag] = int(m["num_ongoing_requests"])
-            except Exception:
-                # Replica dead or unhealthy: drop it; scaling replaces it.
+            except ActorDiedError:
+                # Replica actually died: drop it; scaling replaces it.
                 with self._lock:
                     st.replicas.pop(tag, None)
                     self._bump()
+            except Exception:
+                # Timeout / transient (e.g. constructor still running): keep
+                # the replica and carry forward its last known metric —
+                # dropping here would spawn duplicates for every slow-init
+                # deployment.
+                with self._lock:
+                    if tag in st.last_metrics:
+                        metrics[tag] = st.last_metrics[tag]
         with self._lock:
             st.last_metrics = metrics
 
     def _scale(self, st: _DeploymentState) -> None:
-        from ray_tpu.api import kill
-        from ray_tpu.serve._private.replica import ReplicaActor
-        from ray_tpu.api import remote
-
         with self._lock:
             target = st.target_replicas()
             current = len(st.replicas)
@@ -245,15 +271,14 @@ class ServeControllerActor:
                 to_stop = order[: current - target]
                 for tag in to_stop:
                     h = st.replicas.pop(tag)
-                    try:
-                        h.prepare_for_shutdown.remote()
-                        kill(h)
-                    except Exception:
-                        pass
+                    _stop_replica_gracefully(
+                        h, cfg.graceful_shutdown_timeout_s
+                    )
                 self._bump()
                 return
         # Start new replicas outside the lock (actor creation can be slow).
         from ray_tpu.actor import ActorClass
+        from ray_tpu.serve._private.replica import ReplicaActor
 
         replica_cls = ActorClass(
             ReplicaActor,
@@ -284,14 +309,9 @@ class ServeControllerActor:
             self._bump()
 
     def _stop_all(self, st: _DeploymentState) -> None:
-        from ray_tpu.api import kill
-
+        timeout = st.info["config"].graceful_shutdown_timeout_s
         for h in st.replicas.values():
-            try:
-                h.prepare_for_shutdown.remote()
-                kill(h)
-            except Exception:
-                pass
+            _stop_replica_gracefully(h, timeout)
         st.replicas.clear()
 
     def ping(self) -> str:

@@ -55,7 +55,10 @@ class _BatchQueue:
         self._pad = pad_to_bucket
         self._lock = threading.Lock()
         self._pending: list[_Item] = []
-        self._flusher: Optional[threading.Thread] = None
+        # True while a flusher thread is committed to draining _pending;
+        # transitions happen only under _lock so a submit can never race a
+        # flusher that has already decided to exit.
+        self._flusher_active = False
 
     def submit(self, instance, value) -> Any:
         item = _Item(value)
@@ -65,11 +68,11 @@ class _BatchQueue:
             if len(self._pending) >= self._max:
                 batch = self._drain()
                 run_now = True
-            elif self._flusher is None or not self._flusher.is_alive():
-                self._flusher = threading.Thread(
+            elif not self._flusher_active:
+                self._flusher_active = True
+                threading.Thread(
                     target=self._flush_later, args=(instance,), daemon=True
-                )
-                self._flusher.start()
+                ).start()
         if run_now:
             self._run(instance, batch)
         item.event.wait()
@@ -82,11 +85,18 @@ class _BatchQueue:
         return batch
 
     def _flush_later(self, instance) -> None:
-        time.sleep(self._timeout)
-        with self._lock:
-            batch = self._drain()
-        if batch:
+        while True:
+            time.sleep(self._timeout)
+            with self._lock:
+                batch = self._drain()
+                if not batch:
+                    self._flusher_active = False
+                    return
             self._run(instance, batch)
+            with self._lock:
+                if not self._pending:
+                    self._flusher_active = False
+                    return
 
     def _run(self, instance, batch: list[_Item]) -> None:
         values = [it.value for it in batch]

@@ -27,24 +27,16 @@ class DeploymentResponse:
         self._ref = ref
         self._router = router
         self._replica_tag = replica_tag
-        self._done = False
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         from ray_tpu import api as ray
 
-        try:
-            value = ray.get(self._ref, timeout=timeout_s)
-        finally:
-            self._settle()
-        return value
+        # In-flight accounting settles via the router's on_sealed callback
+        # when the reply lands — nothing to do here beyond the get.
+        return ray.get(self._ref, timeout=timeout_s)
 
     def _to_object_ref(self) -> ObjectRef:
         return self._ref
-
-    def _settle(self) -> None:
-        if not self._done:
-            self._done = True
-            self._router._on_done(self._replica_tag)
 
 
 class Router:
@@ -134,6 +126,16 @@ class Router:
             with self._lock:
                 self._queued -= 1
         ref = handle.handle_request.remote(method_name, args, kwargs)
+
+        # Decrement in-flight when the REPLY arrives, not when the caller
+        # reads it — fire-and-forget .remote() must not pin slots forever
+        # (reference router decrements on task completion). The closure holds
+        # the ref so a dropped DeploymentResponse can't delete the reply
+        # object (and with it this callback) before the reply is sealed.
+        def _on_reply(_ref=ref, _tag=tag):
+            self._on_done(_tag)
+
+        get_runtime().store.on_sealed(ref.id, _on_reply)
         return DeploymentResponse(ref, self, tag)
 
     def _pick_replica(self, timeout_s: float = 30.0):

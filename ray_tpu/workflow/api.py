@@ -1,0 +1,174 @@
+"""Workflow public API + executor.
+
+Reference: workflow/api.py (run/resume/get_output/get_status/list_all) and
+workflow/workflow_executor.py:32,56,92 (the asyncio controller loop polling
+queued steps). Here the executor walks the DAG topologically, submits every
+step whose deps are met as a normal task (so independent steps run in
+parallel through the scheduler), and checkpoints each step's result before
+moving on — making any crash point resumable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    _InputValue,
+)
+from ray_tpu.workflow import storage as storage_mod
+from ray_tpu.workflow.storage import WorkflowStorage, list_workflows
+
+_running: Dict[str, Future] = {}
+_lock = threading.Lock()
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the durable storage base path (reference: workflow.init)."""
+    if storage is not None:
+        storage_mod.set_base(storage)
+
+
+def _step_ids(dag: DAGNode) -> Dict[str, str]:
+    """Deterministic step ids: topological index + function name — stable
+    across process restarts so resume can match checkpoints to steps
+    (reference: workflow_state_from_dag.py name generation)."""
+    ids = {}
+    for i, node in enumerate(dag.topological_order()):
+        if isinstance(node, FunctionNode):
+            name = node._remote_fn._function.__qualname__
+        else:
+            name = type(node).__name__
+        ids[node._stable_uuid] = f"{i:03d}_{name}"
+    return ids
+
+
+def _execute_workflow(
+    workflow_id: str, dag: DAGNode, args: tuple, kwargs: dict
+) -> Any:
+    import ray_tpu
+
+    store = WorkflowStorage(workflow_id)
+    store.save_status("RUNNING")
+    ids = _step_ids(dag)
+    cache: Dict[str, Any] = {}
+    input_value = _InputValue(args, kwargs)
+    order = dag.topological_order()
+    # Submit pass: completed steps load from checkpoint, pending steps are
+    # submitted with upstream ObjectRefs so independent chains overlap.
+    pending: List[tuple] = []
+    for node in order:
+        sid = ids[node._stable_uuid]
+        if isinstance(node, (InputNode, InputAttributeNode)):
+            cache[node._stable_uuid] = node._execute_node(cache, input_value)
+            continue
+        if store.has_step_result(sid):
+            cache[node._stable_uuid] = store.load_step_result(sid)
+            continue
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"Workflows support task DAGs (FunctionNode); got {type(node)}"
+            )
+        ref = node._execute_node(cache, input_value)
+        cache[node._stable_uuid] = ref
+        pending.append((sid, node._stable_uuid, ref))
+    # Checkpoint pass: persist results in topological order.
+    try:
+        for sid, nuid, ref in pending:
+            value = ray_tpu.get(ref)
+            store.save_step_result(sid, value)
+            cache[nuid] = value
+    except BaseException:
+        store.save_status("RESUMABLE")
+        raise
+    result = cache[dag._stable_uuid]
+    store.save_status("SUCCESSFUL")
+    return result
+
+
+def run(
+    dag: DAGNode,
+    *args,
+    workflow_id: Optional[str] = None,
+    **kwargs,
+) -> Any:
+    """Run a workflow to completion, checkpointing each step."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    store = WorkflowStorage(workflow_id)
+    store.save_dag(dag)
+    store.save_input(args, kwargs)
+    store.save_metadata({"workflow_id": workflow_id, "start_time": time.time()})
+    return _execute_workflow(workflow_id, dag, args, kwargs)
+
+
+def run_async(
+    dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs
+) -> Future:
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    store = WorkflowStorage(workflow_id)
+    store.save_dag(dag)
+    store.save_input(args, kwargs)
+    store.save_metadata({"workflow_id": workflow_id, "start_time": time.time()})
+    fut: Future = Future()
+
+    def runner():
+        try:
+            fut.set_result(_execute_workflow(workflow_id, dag, args, kwargs))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    t = threading.Thread(target=runner, daemon=True, name=f"wf-{workflow_id}")
+    with _lock:
+        _running[workflow_id] = fut
+    t.start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Reload the stored DAG and continue from the last checkpoint."""
+    store = WorkflowStorage(workflow_id)
+    dag = store.load_dag()
+    args, kwargs = store.load_input()
+    return _execute_workflow(workflow_id, dag, args, kwargs)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return WorkflowStorage(workflow_id).load_status()
+
+
+def get_metadata(workflow_id: str) -> dict:
+    return WorkflowStorage(workflow_id).load_metadata()
+
+
+def get_output(workflow_id: str, timeout_s: Optional[float] = None) -> Any:
+    with _lock:
+        fut = _running.get(workflow_id)
+    if fut is not None and not fut.done():
+        return fut.result(timeout=timeout_s)
+    store = WorkflowStorage(workflow_id)
+    status = store.load_status()
+    if status != "SUCCESSFUL":
+        raise ValueError(
+            f"Workflow {workflow_id!r} status={status}; resume() it first"
+        )
+    dag = store.load_dag()
+    ids = _step_ids(dag)
+    return store.load_step_result(ids[dag._stable_uuid])
+
+
+def list_all() -> List[tuple]:
+    out = []
+    for wid in list_workflows():
+        out.append((wid, WorkflowStorage(wid).load_status()))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    WorkflowStorage(workflow_id).delete()

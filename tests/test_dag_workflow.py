@@ -1,0 +1,149 @@
+"""DAG + Workflow tests (reference: python/ray/dag/tests, workflow/tests)."""
+
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture
+def wf_storage(tmp_path):
+    workflow.init(storage=str(tmp_path))
+    yield str(tmp_path)
+
+
+def test_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x, y):
+        return x * y
+
+    dag = b.bind(a.bind(2), a.bind(3))
+    assert ray_tpu.get(dag.execute()) == 12
+
+
+def test_dag_with_input_node(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), inp)
+    assert ray_tpu.get(dag.execute(5)) == 15
+    assert ray_tpu.get(dag.execute(7)) == 21
+
+
+def test_actor_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def incr(self, by):
+            self.v += by
+            return self.v
+
+    node = Counter.bind(10)
+    dag = node.incr.bind(5)
+    assert ray_tpu.get(dag.execute()) == 15
+
+
+def test_diamond_dag_shares_upstream(ray_start_regular):
+    calls = []
+
+    @ray_tpu.remote
+    def source():
+        return 1
+
+    @ray_tpu.remote
+    def left(x):
+        return x + 10
+
+    @ray_tpu.remote
+    def right(x):
+        return x + 100
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    s = source.bind()
+    dag = join.bind(left.bind(s), right.bind(s))
+    assert ray_tpu.get(dag.execute()) == 112
+
+
+def test_workflow_run_and_output(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def g(x):
+        return x + 1
+
+    result = workflow.run(g.bind(f.bind(10)), workflow_id="w1")
+    assert result == 21
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    assert workflow.get_output("w1") == 21
+    assert ("w1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(ray_start_regular, wf_storage, tmp_path):
+    marker = tmp_path / "side_effects.txt"
+
+    @ray_tpu.remote
+    def step_a():
+        with open(marker, "a") as f:
+            f.write("a\n")
+        return 5
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+
+        if not os.path.exists(str(marker) + ".allow"):
+            raise RuntimeError("injected failure")
+        return x * 10
+
+    dag = flaky.bind(step_a.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "RESUMABLE"
+    # Heal the failure, resume: step_a must NOT re-execute.
+    open(str(marker) + ".allow", "w").close()
+    assert workflow.resume("w2") == 50
+    assert workflow.get_status("w2") == "SUCCESSFUL"
+    assert open(marker).read().count("a") == 1
+
+
+def test_workflow_run_async(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(0.2)
+        return 42
+
+    fut = workflow.run_async(slow.bind(), workflow_id="w3")
+    assert workflow.get_output("w3", timeout_s=10) == 42
+    assert fut.result() == 42
+
+
+def test_workflow_delete(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w4")
+    workflow.delete("w4")
+    assert workflow.get_status("w4") is None

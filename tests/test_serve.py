@@ -233,3 +233,59 @@ def test_http_proxy(serve_instance):
         assert body["result"] == 42
     finally:
         stop_proxy()
+
+
+def test_slow_init_replica_not_duplicated(serve_instance):
+    """Regression: metrics-poll timeouts on a slow-__init__ replica must not
+    drop it and spawn duplicates."""
+
+    @serve.deployment
+    class SlowInit:
+        def __init__(self):
+            time.sleep(3.0)  # longer than the 2s metrics timeout
+            self.ready = True
+
+        def __call__(self, _):
+            return "ok"
+
+    handle = serve.run(SlowInit.bind(), _blocking_timeout_s=60.0)
+    assert handle.remote(None).result(timeout_s=30) == "ok"
+    st = serve.status()["default"]["SlowInit"]
+    assert st["num_replicas"] == 1
+
+
+def test_fire_and_forget_does_not_exhaust_slots(serve_instance):
+    """Regression: .remote() without .result() must free in-flight slots when
+    the reply lands."""
+
+    @serve.deployment(max_concurrent_queries=2)
+    class Fast:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Fast.bind())
+    for i in range(10):
+        handle.remote(i)  # never read
+    time.sleep(0.5)
+    # Slots freed -> this must not block/timeout.
+    assert handle.remote(99).result(timeout_s=10) == 99
+
+
+def test_graceful_shutdown_hook_runs(serve_instance, tmp_path):
+    marker = tmp_path / "shutdown.txt"
+
+    @serve.deployment
+    class WithCleanup:
+        def __call__(self, _):
+            return 1
+
+        def shutdown(self):
+            with open(marker, "w") as f:
+                f.write("clean")
+
+    serve.run(WithCleanup.bind())
+    serve.shutdown()
+    deadline = time.time() + 10
+    while time.time() < deadline and not marker.exists():
+        time.sleep(0.1)
+    assert marker.exists() and marker.read_text() == "clean"

@@ -1,0 +1,269 @@
+"""Streaming generators + util extras (ActorPool, Queue, multiprocessing Pool).
+
+Reference test models: python/ray/tests/test_streaming_generator.py,
+test_actor_pool.py, test_queue.py, util/multiprocessing tests.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+# ---------------- streaming generators ----------------
+
+
+def test_streaming_generator_basic(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_streaming_generator_incremental(ray_start_regular):
+    """Consumer sees early items while the producer is still running."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(3):
+            yield i
+            time.sleep(0.3)
+
+    start = time.monotonic()
+    it = iter(gen_obj := slow_gen.remote())
+    first = ray_tpu.get(next(it))
+    first_latency = time.monotonic() - start
+    assert first == 0
+    # Got item 0 well before the full ~0.9s run completes.
+    assert first_latency < 0.6
+    rest = [ray_tpu.get(r) for r in it]
+    assert rest == [1, 2]
+
+
+def test_streaming_generator_error_mid_stream(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom")
+
+    refs = list(bad_gen.remote())
+    assert ray_tpu.get(refs[0]) == 1
+    assert ray_tpu.get(refs[1]) == 2
+    with pytest.raises(Exception, match="boom"):
+        ray_tpu.get(refs[2])
+
+
+def test_streaming_generator_on_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Gen:
+        @ray_tpu.method(num_returns="streaming")
+        def produce(self, n):
+            for i in range(n):
+                yield i + 100
+
+    g = Gen.remote()
+    out = [ray_tpu.get(r) for r in g.produce.remote(3)]
+    assert out == [100, 101, 102]
+
+
+def test_streaming_generator_empty(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield 1
+
+    assert list(empty.remote()) == []
+
+
+# ---------------- ActorPool ----------------
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Worker.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def work(self, x):
+            time.sleep(0.01 * (x % 3))
+            return x
+
+    pool = ActorPool([Worker.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, v: a.work.remote(v), range(9)))
+    assert sorted(out) == list(range(9))
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def echo(self, x):
+            return x
+
+    pool = ActorPool([Worker.remote()])
+    pool.submit(lambda a, v: a.echo.remote(v), "a")
+    pool.submit(lambda a, v: a.echo.remote(v), "b")
+    assert pool.get_next() == "a"
+    assert pool.get_next() == "b"
+    assert not pool.has_next()
+
+
+# ---------------- Queue ----------------
+
+
+def test_queue_fifo(ray_start_regular):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+
+
+def test_queue_maxsize_and_timeouts(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.1)
+    assert q.get() == 1
+    q.put(3)
+    with pytest.raises(Empty):
+        Queue().get(timeout=0.1)
+
+
+def test_queue_batch_ops(ray_start_regular):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(2) == [1, 2]
+    with pytest.raises(Empty):
+        q.get_nowait_batch(5)
+
+
+def test_queue_producer_consumer_threads(ray_start_regular):
+    import threading
+
+    q = Queue(maxsize=4)
+    results = []
+
+    def producer():
+        for i in range(20):
+            q.put(i)
+
+    def consumer():
+        for _ in range(20):
+            results.append(q.get(timeout=10))
+
+    tp = threading.Thread(target=producer)
+    tc = threading.Thread(target=consumer)
+    tp.start(); tc.start()
+    tp.join(timeout=30); tc.join(timeout=30)
+    assert results == list(range(20))
+
+
+# ---------------- multiprocessing Pool ----------------
+
+
+def _square(x):
+    return x * x
+
+
+def test_pool_map(ray_start_regular):
+    with Pool(2) as pool:
+        assert pool.map(_square, range(6)) == [0, 1, 4, 9, 16, 25]
+
+
+def test_pool_apply_and_async(ray_start_regular):
+    with Pool(2) as pool:
+        assert pool.apply(_square, (3,)) == 9
+        res = pool.apply_async(_square, (4,))
+        assert res.get(timeout=10) == 16
+
+
+def test_pool_starmap_imap(ray_start_regular):
+    def add(a, b):
+        return a + b
+
+    with Pool(2) as pool:
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(pool.imap(_square, range(4), chunksize=2)) == [0, 1, 4, 9]
+        assert sorted(pool.imap_unordered(_square, range(4), chunksize=1)) == [
+            0,
+            1,
+            4,
+            9,
+        ]
+
+
+def test_streaming_generator_on_async_actor(ray_start_regular):
+    """Regression: streaming methods on async actors must drive the generator."""
+
+    @ray_tpu.remote
+    class AsyncGen:
+        async def ping(self):
+            return "pong"
+
+        @ray_tpu.method(num_returns="streaming")
+        async def produce(self, n):
+            for i in range(n):
+                yield i * 2
+
+        @ray_tpu.method(num_returns="streaming")
+        def produce_sync(self, n):
+            for i in range(n):
+                yield i + 1
+
+    a = AsyncGen.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    assert [ray_tpu.get(r) for r in a.produce.remote(3)] == [0, 2, 4]
+    assert [ray_tpu.get(r) for r in a.produce_sync.remote(3)] == [1, 2, 3]
+
+
+def test_actor_pool_timeout_is_retryable(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def work(self):
+            time.sleep(0.5)
+            return "done"
+
+    pool = ActorPool([Slow.remote()])
+    pool.submit(lambda a, v: a.work.remote(), None)
+    with pytest.raises(TimeoutError):
+        pool.get_next(timeout=0.05)
+    # State unchanged: retry succeeds and the actor returns to the pool.
+    assert pool.get_next(timeout=10) == "done"
+    assert pool.has_free()
+
+
+def test_actor_pool_task_error_returns_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Flaky:
+        def work(self, fail):
+            if fail:
+                raise ValueError("nope")
+            return "ok"
+
+    pool = ActorPool([Flaky.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), True)
+    with pytest.raises(Exception, match="nope"):
+        pool.get_next()
+    pool.submit(lambda a, v: a.work.remote(v), False)
+    assert pool.get_next() == "ok"
