@@ -66,7 +66,9 @@ def _run_callable(fn: Callable, args: tuple, kwargs: dict) -> TaskResult:
         return TaskResult(exc=exc, traceback_str=traceback.format_exc())
 
 
-def _maybe_consume_stream(spec: TaskSpec, result: TaskResult) -> TaskResult:
+def _maybe_consume_stream(
+    spec: TaskSpec, result: TaskResult, should_abort: Optional[Callable] = None
+) -> TaskResult:
     """For streaming tasks whose function returned a generator: drive it on
     this worker thread (resources stay held), sealing each yielded item as its
     own object via the owner (reference: execute_task's generator path,
@@ -84,6 +86,12 @@ def _maybe_consume_stream(spec: TaskSpec, result: TaskResult) -> TaskResult:
     i = 0
     try:
         for item in gen:
+            # Abort between yields when the hosting actor was killed — the
+            # thread can't be interrupted, but the stream must not keep
+            # producing items for a dead actor.
+            if should_abort is not None and should_abort():
+                gen.close()
+                break
             runtime.report_stream_item(spec, i, value=item)
             i += 1
     except BaseException as exc:  # noqa: BLE001
@@ -373,9 +381,20 @@ class ActorExecutor:
             args, kwargs = self._resolve_args(spec)
             method = getattr(self.instance, spec.method_name)
             result = _run_callable(method, args, kwargs)
-            result = _maybe_consume_stream(spec, result)
+            result = _maybe_consume_stream(
+                spec, result, should_abort=lambda: self.dead
+            )
         except BaseException as exc:  # noqa: BLE001
             result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
+        with self._lock:
+            dead, reason = self.dead, self.death_reason
+        if dead:
+            # The method outlived a kill (threads can't be preempted): its
+            # result must surface as the actor's death, matching the
+            # reference's force-killed-worker semantics.
+            result = TaskResult(
+                exc=ActorDiedError(self.actor_id, reason or "actor killed")
+            )
         self._on_task_done(spec, self.node.node, {}, result)
 
     def _drain_inbox(self) -> None:

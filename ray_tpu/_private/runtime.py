@@ -710,8 +710,6 @@ class Runtime:
                 self.scheduler.notify()
                 return
         self._finalize(spec, result, already_decrefed=True)
-        if spec.streaming:
-            self._finish_stream(spec, result)
         if spec.kind == TaskKind.ACTOR_CREATION:
             actor_record = self.controller.get_actor_record(spec.actor_id)
             if result.exc is None:
@@ -768,16 +766,12 @@ class Runtime:
         else:
             result = TaskResult(exc=exc)
             self._finalize(record.spec, result)
-            if record.spec.streaming:
-                self._finish_stream(record.spec, result)
 
     def _fail_unscheduled(self, spec: TaskSpec, exc: BaseException) -> None:
         """Scheduler could not place the task (infeasible / bad PG)."""
         self.refcount.update_finished_task_references(self._dep_ids(spec))
         result = TaskResult(exc=exc)
         self._finalize(spec, result, already_decrefed=True)
-        if spec.streaming:
-            self._finish_stream(spec, result)
 
     def _finalize(
         self, spec: TaskSpec, result: TaskResult, already_decrefed: bool = False
@@ -790,32 +784,39 @@ class Runtime:
                 record.finalized = True
                 if spec.kind != TaskKind.ACTOR_CREATION:
                     self._task_records.pop(spec.task_id, None)
-        if not already_decrefed:
-            self.refcount.update_finished_task_references(self._dep_ids(spec))
-        if result.cancelled:
-            error = ErrorObject(
-                result.exc or TaskCancelledError(spec.task_id), result.traceback_str
-            )
-            for oid in spec.return_ids:
-                self.store.seal(oid, error)
-            return
-        if result.exc is not None:
-            exc = result.exc
-            if not isinstance(exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)):
-                exc = TaskError(exc, result.traceback_str, spec.name)
-            error = ErrorObject(exc, result.traceback_str)
-            for oid in spec.return_ids:
-                self.store.seal(oid, error)
-            return
         try:
-            self._seal_returns(spec, result.value)
-        except MemoryError as exc:
-            # The value didn't fit in the store even after eviction; surface
-            # the OOM to the caller instead of leaving returns unsealed forever
-            # (the reference spills to disk here — spilling is a later milestone).
-            error = ErrorObject(TaskError(exc, "", spec.name))
-            for oid in spec.return_ids:
-                self.store.seal(oid, error)
+            if not already_decrefed:
+                self.refcount.update_finished_task_references(self._dep_ids(spec))
+            if result.cancelled:
+                error = ErrorObject(
+                    result.exc or TaskCancelledError(spec.task_id), result.traceback_str
+                )
+                for oid in spec.return_ids:
+                    self.store.seal(oid, error)
+                return
+            if result.exc is not None:
+                exc = result.exc
+                if not isinstance(exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)):
+                    exc = TaskError(exc, result.traceback_str, spec.name)
+                error = ErrorObject(exc, result.traceback_str)
+                for oid in spec.return_ids:
+                    self.store.seal(oid, error)
+                return
+            try:
+                self._seal_returns(spec, result.value)
+            except MemoryError as exc:
+                # The value didn't fit in the store even after eviction; surface
+                # the OOM to the caller instead of leaving returns unsealed forever
+                # (the reference spills to disk here — spilling is a later milestone).
+                error = ErrorObject(TaskError(exc, "", spec.name))
+                for oid in spec.return_ids:
+                    self.store.seal(oid, error)
+        finally:
+            # Every finalize path must release stream consumers, or a
+            # generator killed/cancelled before producing hangs its reader
+            # (kill/cancel/actor-death paths call _finalize directly).
+            if spec.streaming:
+                self._finish_stream(spec, result)
 
     def _seal_returns(self, spec: TaskSpec, value: Any) -> None:
         n = spec.num_returns

@@ -25,10 +25,9 @@ class DAGNode:
     # -- traversal -----------------------------------------------------
 
     def _children(self) -> List["DAGNode"]:
-        out = []
+        out: List[DAGNode] = []
         for a in list(self._bound_args) + list(self._bound_kwargs.values()):
-            if isinstance(a, DAGNode):
-                out.append(a)
+            _scan_nodes(a, out)
         return out
 
     def topological_order(self) -> List["DAGNode"]:
@@ -58,8 +57,17 @@ class DAGNode:
         return cache[self._stable_uuid]
 
     def _resolve(self, value: Any, cache: Dict[str, Any], input_value) -> Any:
+        """Swap DAGNodes for their results, scanning into list/tuple/dict
+        containers (reference dag_node.py uses a scanner for exactly this:
+        nested nodes in collection args must execute, not pass through raw)."""
         if isinstance(value, DAGNode):
             return cache[value._stable_uuid]
+        if isinstance(value, list):
+            return [self._resolve(v, cache, input_value) for v in value]
+        if isinstance(value, tuple):
+            return tuple(self._resolve(v, cache, input_value) for v in value)
+        if isinstance(value, dict):
+            return {k: self._resolve(v, cache, input_value) for k, v in value.items()}
         return value
 
     def _resolved_args(self, cache, input_value):
@@ -72,6 +80,17 @@ class DAGNode:
 
     def _execute_node(self, cache, input_value) -> Any:
         raise NotImplementedError
+
+
+def _scan_nodes(value: Any, out: List["DAGNode"]) -> None:
+    if isinstance(value, DAGNode):
+        out.append(value)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _scan_nodes(v, out)
+    elif isinstance(value, dict):
+        for v in value.values():
+            _scan_nodes(v, out)
 
 
 class _InputValue:

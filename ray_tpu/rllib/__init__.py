@@ -1,0 +1,22 @@
+"""ray_tpu.rllib — RL at scale, TPU-native.
+
+Re-design of the reference's RLlib **new stack only** (SURVEY.md §2.3, §7.7):
+RLModule (flax) / Learner (jitted SGD over a device mesh) / LearnerGroup
+(learner actors on TPU hosts) / EnvRunner actor pool on CPU nodes. The legacy
+Policy/RolloutWorker stack (rllib/policy/, rllib/evaluation/rollout_worker.py)
+is deliberately not reproduced — the reference was migrating off it.
+
+Layering rule preserved from the reference: rllib uses only the public
+task/actor/object API (ray_tpu.remote / actors / ObjectRefs) — no runtime
+internals.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "MultiAgentBatch",
+    "SampleBatch",
+]

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig"]

@@ -1,0 +1,267 @@
+"""Algorithm + AlgorithmConfig — the user-facing training surface.
+
+Reference: rllib/algorithms/algorithm.py:757 (step → training_step) and
+algorithm_config.py (fluent AlgorithmConfig). Algorithm is a Tune `Trainable`,
+so `algo.train()`, `Tuner(algo_cls, param_space=config)` and checkpointing all
+come from the same protocol the reference uses (tune/trainable/trainable.py:350).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.evaluation.worker_set import EnvRunnerGroup
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, concat_samples
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config object; `.environment().env_runners().training()` chains
+    (reference: algorithm_config.py). Copy-on-build: `build()` freezes a deep
+    copy into the Algorithm."""
+
+    algo_class: Optional[type] = None
+
+    def __init__(self, algo_class: Optional[type] = None):
+        if algo_class is not None:
+            self.algo_class = algo_class
+        # environment
+        self.env: Any = None
+        self.env_config: dict = {}
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.num_cpus_per_env_runner: float = 1
+        self.rollout_fragment_length: Optional[int] = None
+        self.restart_failed_env_runners: bool = True
+        # training
+        self.gamma: float = 0.99
+        self.lr: float = 5e-4
+        self.train_batch_size: int = 4000
+        self.minibatch_size: Optional[int] = None
+        self.num_epochs: int = 1
+        self.grad_clip: Optional[float] = None
+        self.model: dict = {}
+        # learners
+        self.num_learners: int = 0
+        self.num_cpus_per_learner: float = 1
+        self.num_tpus_per_learner: float = 0
+        # debugging / reproducibility
+        self.seed: Optional[int] = 0
+        # internal
+        self.rl_module_spec: Optional[RLModuleSpec] = None
+        self._compute_gae_on_runner: bool = False
+
+    # -- fluent setters ---------------------------------------------------
+
+    def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(
+        self,
+        *,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        num_cpus_per_env_runner: Optional[float] = None,
+        restart_failed_env_runners: Optional[bool] = None,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if restart_failed_env_runners is not None:
+            self.restart_failed_env_runners = restart_failed_env_runners
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"Unknown training config {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(
+        self,
+        *,
+        num_learners: Optional[int] = None,
+        num_cpus_per_learner: Optional[float] = None,
+        num_tpus_per_learner: Optional[float] = None,
+    ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_cpus_per_learner is not None:
+            self.num_cpus_per_learner = num_cpus_per_learner
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def rl_module(self, *, rl_module_spec: Optional[RLModuleSpec] = None) -> "AlgorithmConfig":
+        self.rl_module_spec = rl_module_spec
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- build ------------------------------------------------------------
+
+    def get_rollout_fragment_length(self) -> int:
+        if self.rollout_fragment_length:
+            return self.rollout_fragment_length
+        runners = max(1, self.num_env_runners)
+        return max(1, self.train_batch_size // (runners * self.num_envs_per_env_runner))
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        return {
+            k: v for k, v in vars(self).items() if not k.startswith("__")
+        }
+
+    def update_from_dict(self, d: dict) -> "AlgorithmConfig":
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self.env = env
+        assert self.algo_class is not None, "config has no algo_class"
+        return self.algo_class(config=self.copy())
+
+    # Learner/spec hooks overridden per algorithm --------------------------
+
+    def get_default_learner_class(self) -> Type[Learner]:
+        raise NotImplementedError
+
+    def build_learner_group(self, spec: RLModuleSpec) -> LearnerGroup:
+        learner_cls = self.get_default_learner_class()
+        cfg = self
+
+        def builder():
+            return learner_cls(spec, config=cfg)
+
+        return LearnerGroup(
+            builder,
+            num_learners=self.num_learners,
+            num_cpus_per_learner=self.num_cpus_per_learner,
+            num_tpus_per_learner=self.num_tpus_per_learner,
+        )
+
+
+class Algorithm(Trainable):
+    """Tune-trainable RL algorithm driving EnvRunnerGroup + LearnerGroup."""
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config: Optional[Any] = None, env=None, **kwargs):
+        if isinstance(config, dict):
+            cfg = self.config_class()
+            cfg.update_from_dict(config)
+            config = cfg
+        elif config is None:
+            config = self.config_class()
+        if env is not None:
+            config.env = env
+        self.algo_config = config
+        super().__init__(config=config.to_dict(), **kwargs)
+
+    # -- Trainable protocol -----------------------------------------------
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        # Always keep a local runner — it serves spaces, evaluation and
+        # compute_single_action even when sampling is all-remote (reference:
+        # WorkerSet always builds a local worker, worker_set.py:80).
+        self.env_runner_group = EnvRunnerGroup(cfg, local=True)
+        obs_space, act_space = self.env_runner_group.local_runner.spaces()
+        spec = cfg.rl_module_spec or RLModuleSpec(
+            observation_space=obs_space,
+            action_space=act_space,
+            model_config=dict(cfg.model),
+            seed=cfg.seed or 0,
+        )
+        cfg.rl_module_spec = spec
+        self.learner_group = cfg.build_learner_group(spec)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._env_steps_total = 0
+
+    def step(self) -> dict:
+        results = self.training_step()
+        metrics = self.env_runner_group.collect_metrics()
+        results.update(metrics)
+        results["num_env_steps_sampled_lifetime"] = self._env_steps_total
+        return results
+
+    def training_step(self) -> dict:
+        """Default on-policy skeleton: sample → update → sync weights
+        (reference algorithm.py training_step default)."""
+        cfg = self.algo_config
+        batches = []
+        count = 0
+        while count < cfg.train_batch_size:
+            batch = self.env_runner_group.sample(cfg.get_rollout_fragment_length())
+            batches.append(batch)
+            count += batch.count
+        train_batch = concat_samples(batches)
+        self._env_steps_total += train_batch.count
+        learner_results = self.learner_group.update(train_batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return dict(learner_results)
+
+    # -- checkpointing -----------------------------------------------------
+
+    def save_checkpoint(self) -> Optional[dict]:
+        return {"learner": self.learner_group.get_state()}
+
+    def load_checkpoint(self, state: Optional[dict]) -> None:
+        if state:
+            self.learner_group.set_state(state["learner"])
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.shutdown()
+
+    # -- convenience -------------------------------------------------------
+
+    def get_module(self):
+        if self.learner_group.is_local:
+            return self.learner_group._local.module
+        return None
+
+    def compute_single_action(self, obs, explore: bool = False):
+        """Serving-style single-action inference (reference algorithm.py
+        compute_single_action)."""
+        runner = self.env_runner_group.local_runner
+        assert runner is not None
+        obs = np.asarray(obs, dtype=np.float32)[None]
+        if explore:
+            import jax
+
+            runner._rng, key = jax.random.split(runner._rng)
+            out = runner._explore_fn(runner.module.params, {SampleBatch.OBS: obs}, key)
+        else:
+            out = runner.module.forward_inference(
+                runner.module.params, {SampleBatch.OBS: obs}
+            )
+        action = np.asarray(out[SampleBatch.ACTIONS])[0]
+        return action

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig, PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner"]

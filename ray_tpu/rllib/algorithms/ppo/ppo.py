@@ -1,0 +1,118 @@
+"""PPO — clipped-surrogate policy optimization.
+
+Reference: rllib/algorithms/ppo/ppo.py:394 (PPOConfig), :420 (training_step)
+and the new-stack loss (ppo/torch/ppo_torch_learner.py compute_loss_for_module).
+The whole loss+grad+apply step is one jitted function in PPOLearner; GAE runs
+on the env runners (postprocessing.py) so the learner sees ready advantage
+columns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or PPO)
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 30
+        self.lambda_ = 0.95
+        self.use_gae = True
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.use_kl_loss = True
+        self.grad_clip = None
+        self._compute_gae_on_runner = True
+
+    def get_default_learner_class(self):
+        return PPOLearner
+
+
+class PPOLearner(Learner):
+    def build(self) -> None:
+        super().build()
+        self._kl_coeff = float(getattr(self.config, "kl_coeff", 0.2))
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        module = self.module
+        fwd = module.forward_train(params, batch)
+        dist = module.dist_cls(fwd[SampleBatch.ACTION_DIST_INPUTS])
+        old_dist = module.dist_cls(batch[SampleBatch.ACTION_DIST_INPUTS])
+        logp = dist.logp(batch[SampleBatch.ACTIONS])
+        logp_ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+
+        # Per-minibatch advantage standardization (reference:
+        # rllib/utils/sgd.py standardized() applied in ppo training_step).
+        advantages = batch[SampleBatch.ADVANTAGES]
+        advantages = (advantages - advantages.mean()) / jnp.maximum(
+            advantages.std(), 1e-4
+        )
+        surrogate = -jnp.minimum(
+            advantages * logp_ratio,
+            advantages
+            * jnp.clip(logp_ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param),
+        )
+
+        value_fn_out = fwd[SampleBatch.VF_PREDS]
+        vf_err = (value_fn_out - batch[SampleBatch.VALUE_TARGETS]) ** 2
+        vf_loss = jnp.clip(vf_err, 0.0, cfg.vf_clip_param)
+
+        entropy = dist.entropy()
+        kl = old_dist.kl(dist)
+
+        total = jnp.mean(
+            surrogate
+            + cfg.vf_loss_coeff * vf_loss
+            - cfg.entropy_coeff * entropy
+        )
+        if cfg.use_kl_loss:
+            total = total + self._kl_coeff * jnp.mean(kl)
+        metrics = {
+            "policy_loss": jnp.mean(surrogate),
+            "vf_loss": jnp.mean(vf_loss),
+            "entropy": jnp.mean(entropy),
+            "mean_kl": jnp.mean(kl),
+        }
+        return total, metrics
+
+    def after_update(self, batch) -> None:
+        """Adaptive KL coefficient (reference ppo.py update_kl: 1.5x/0.5x
+        thresholds around kl_target). The coefficient is baked into the traced
+        loss as a constant, so a change invalidates the jitted update fn; the
+        2x/0.5x step rule keeps re-traces rare."""
+        cfg = self.config
+        if not getattr(cfg, "use_kl_loss", False):
+            return
+        kl = self._last_mean_kl if hasattr(self, "_last_mean_kl") else None
+        if kl is None:
+            return
+        if kl > 2.0 * cfg.kl_target:
+            self._kl_coeff *= 1.5
+            self._update_fn = None  # re-trace with new coefficient
+        elif kl < 0.5 * cfg.kl_target:
+            self._kl_coeff *= 0.5
+            self._update_fn = None
+
+    def update(self, batch) -> dict:
+        out = super().update(batch)
+        self._last_mean_kl = out.get("mean_kl")
+        return out
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig

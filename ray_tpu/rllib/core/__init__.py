@@ -1,0 +1,23 @@
+from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian, get_dist_cls
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    MultiAgentRLModule,
+    PiVfNet,
+    QNet,
+    RLModule,
+    RLModuleSpec,
+)
+
+__all__ = [
+    "Categorical",
+    "DiagGaussian",
+    "Learner",
+    "LearnerGroup",
+    "MultiAgentRLModule",
+    "PiVfNet",
+    "QNet",
+    "RLModule",
+    "RLModuleSpec",
+    "get_dist_cls",
+]

@@ -1,0 +1,94 @@
+"""Action distributions in jax — categorical and diagonal gaussian.
+
+Reference: rllib/models/distributions.py + torch_distributions.py (new-stack
+Distribution API: from_logits / sample / logp / entropy / kl). Everything is
+pure-functional over jnp arrays so it traces inside the jitted loss and the
+jitted action-sampling step alike.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    def __init__(self, logits: jnp.ndarray):
+        self.logits = logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True
+        )
+
+    def sample(self, rng: jax.Array) -> jnp.ndarray:
+        return jax.random.categorical(rng, self.logits, axis=-1)
+
+    def deterministic_sample(self) -> jnp.ndarray:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take_along_axis(
+            self.logits, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    def entropy(self) -> jnp.ndarray:
+        probs = jnp.exp(self.logits)
+        return -jnp.sum(probs * self.logits, axis=-1)
+
+    def kl(self, other: "Categorical") -> jnp.ndarray:
+        probs = jnp.exp(self.logits)
+        return jnp.sum(probs * (self.logits - other.logits), axis=-1)
+
+
+class DiagGaussian:
+    """dist_inputs = concat([mean, log_std], axis=-1)."""
+
+    def __init__(self, dist_inputs: jnp.ndarray):
+        self.mean, self.log_std = jnp.split(dist_inputs, 2, axis=-1)
+        self.std = jnp.exp(jnp.clip(self.log_std, -20.0, 2.0))
+
+    def sample(self, rng: jax.Array) -> jnp.ndarray:
+        return self.mean + self.std * jax.random.normal(rng, self.mean.shape)
+
+    def deterministic_sample(self) -> jnp.ndarray:
+        return self.mean
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        z = (actions - self.mean) / self.std
+        return jnp.sum(
+            -0.5 * z**2 - jnp.log(self.std) - 0.5 * jnp.log(2.0 * jnp.pi), axis=-1
+        )
+
+    def entropy(self) -> jnp.ndarray:
+        return jnp.sum(
+            jnp.log(self.std) + 0.5 * (1.0 + jnp.log(2.0 * jnp.pi)), axis=-1
+        )
+
+    def kl(self, other: "DiagGaussian") -> jnp.ndarray:
+        return jnp.sum(
+            other.log_std
+            - self.log_std
+            + (self.std**2 + (self.mean - other.mean) ** 2) / (2.0 * other.std**2)
+            - 0.5,
+            axis=-1,
+        )
+
+
+def get_dist_cls(action_space):
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    if isinstance(action_space, Discrete):
+        return Categorical
+    if isinstance(action_space, Box):
+        return DiagGaussian
+    raise ValueError(f"No distribution for action space {action_space!r}")
+
+
+def dist_input_dim(action_space) -> int:
+    """Width of the model's action-head output for this space."""
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+    import numpy as np
+
+    if isinstance(action_space, Discrete):
+        return action_space.n
+    if isinstance(action_space, Box):
+        return 2 * int(np.prod(action_space.shape))
+    raise ValueError(f"No distribution for action space {action_space!r}")

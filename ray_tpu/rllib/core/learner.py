@@ -1,0 +1,180 @@
+"""Learner — jitted SGD over an RLModule, optionally sharded over a mesh.
+
+Reference: rllib/core/learner/learner.py (:170 build, :482 update, :604
+compute_gradients, :1086 apply_gradients) and torch_learner.py:51 (framework
+learner). The TPU re-design: instead of a DDP-wrapped torch module, the whole
+(loss → grad → optimizer) step is ONE jitted function; data parallelism is a
+`dp` mesh axis with the batch sharded and params replicated, so XLA emits the
+gradient all-reduce over ICI (no NCCL, no wrapper class — SURVEY.md §2.5).
+Subclasses implement `compute_loss(params, batch, rng)` returning
+(scalar_loss, metrics_dict); everything else is generic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+DEVICE_COLUMNS_EXCLUDED = (SampleBatch.INFOS,)
+
+
+def _to_device_batch(batch: Mapping) -> dict:
+    return {
+        k: np.asarray(v)
+        for k, v in batch.items()
+        if k not in DEVICE_COLUMNS_EXCLUDED and isinstance(v, (np.ndarray, jnp.ndarray))
+    }
+
+
+class Learner:
+    """Owns module params + optax state; runs the jitted update."""
+
+    def __init__(
+        self,
+        module_spec: RLModuleSpec,
+        config: Optional[Any] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+    ):
+        self.config = config
+        self.module_spec = module_spec
+        self.module: Optional[RLModule] = None
+        self.mesh = mesh
+        self._opt_state = None
+        self._update_fn: Optional[Callable] = None
+        self._grad_fn: Optional[Callable] = None
+        self._rng = jax.random.PRNGKey(getattr(config, "seed", 0) or 0)
+        self._built = False
+
+    # -- construction -----------------------------------------------------
+
+    def build(self) -> None:
+        if self._built:
+            return
+        self.module = self.module_spec.build()
+        self.optimizer = self.configure_optimizer()
+        self._opt_state = self.optimizer.init(self.module.params)
+        self._built = True
+
+    def configure_optimizer(self) -> optax.GradientTransformation:
+        lr = getattr(self.config, "lr", 5e-4) if self.config else 5e-4
+        clip = getattr(self.config, "grad_clip", None) if self.config else None
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(lr))
+        return optax.chain(*chain)
+
+    # -- algorithm hook ----------------------------------------------------
+
+    def compute_loss(self, params, batch: Mapping, rng) -> Tuple[jnp.ndarray, dict]:
+        raise NotImplementedError
+
+    # -- update path -------------------------------------------------------
+
+    def _make_update_fn(self):
+        optimizer = self.optimizer
+
+        def update_step(params, opt_state, batch, rng):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True
+            )(params, batch, rng)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.mesh
+            data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+            replicated = NamedSharding(mesh, P())
+            batch_sharding = NamedSharding(mesh, P(data_axes))
+            jitted = jax.jit(
+                update_step,
+                in_shardings=(replicated, replicated, batch_sharding, replicated),
+                out_shardings=(replicated, replicated, replicated),
+                donate_argnums=(0, 1),
+            )
+        else:
+            jitted = jax.jit(update_step, donate_argnums=(0, 1))
+        return jitted
+
+    def update(self, batch: SampleBatch) -> dict:
+        """One pass of minibatch SGD over `batch`; returns averaged metrics
+        (reference learner.py:482 update semantics)."""
+        assert self._built, "call build() first"
+        if self._update_fn is None:
+            self._update_fn = self._make_update_fn()
+        cfg = self.config
+        minibatch_size = getattr(cfg, "minibatch_size", None) or batch.count
+        num_epochs = getattr(cfg, "num_epochs", 1) or 1
+        all_metrics = []
+        for mb in batch.minibatches(minibatch_size, num_epochs=num_epochs):
+            self._rng, key = jax.random.split(self._rng)
+            device_batch = _to_device_batch(mb)
+            self.module.params, self._opt_state, metrics = self._update_fn(
+                self.module.params, self._opt_state, device_batch, key
+            )
+            all_metrics.append(metrics)
+        out = {
+            k: float(np.mean([jax.device_get(m[k]) for m in all_metrics]))
+            for k in all_metrics[0]
+        }
+        self.after_update(batch)
+        return out
+
+    def after_update(self, batch: SampleBatch) -> None:
+        """Post-update hook (target-network sync etc.)."""
+
+    # -- gradient-level API (reference learner.py:604,:1086) ---------------
+
+    def compute_gradients(self, batch: SampleBatch) -> Tuple[Any, dict]:
+        assert self._built
+        if self._grad_fn is None:
+            self._grad_fn = jax.jit(
+                lambda params, b, rng: jax.value_and_grad(
+                    self.compute_loss, has_aux=True
+                )(params, b, rng)
+            )
+        self._rng, key = jax.random.split(self._rng)
+        (loss, metrics), grads = self._grad_fn(
+            self.module.params, _to_device_batch(batch), key
+        )
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        return grads, {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+    def apply_gradients(self, grads: Any) -> None:
+        assert self._built
+        updates, self._opt_state = self.optimizer.update(
+            grads, self._opt_state, self.module.params
+        )
+        self.module.params = optax.apply_updates(self.module.params, updates)
+
+    # -- state -------------------------------------------------------------
+
+    def get_weights(self) -> Any:
+        return self.module.get_state()
+
+    def set_weights(self, weights: Any) -> None:
+        self.module.set_state(weights)
+
+    def get_state(self) -> dict:
+        return {
+            "weights": jax.device_get(self.module.params),
+            "opt_state": jax.device_get(self._opt_state),
+        }
+
+    def set_state(self, state: Mapping) -> None:
+        self.module.params = state["weights"]
+        self._opt_state = state["opt_state"]

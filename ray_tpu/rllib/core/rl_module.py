@@ -1,0 +1,174 @@
+"""RLModule — the neural-network holder of the new stack, in flax.
+
+Reference: rllib/core/rl_module/rl_module.py (RLModule, SingleAgentRLModuleSpec)
+and marl_module.py (MultiAgentRLModule). An RLModule owns a flax module + its
+params and exposes the three forward passes: `forward_inference` (deterministic
+serving), `forward_exploration` (sampling rollouts), `forward_train` (loss
+inputs). All three are pure functions of (params, batch) so the Learner can
+jit/pjit them; the module object itself holds no device state beyond params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.core.distributions import dist_input_dim, get_dist_cls
+from ray_tpu.rllib.env.spaces import Space, flat_dim
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class PiVfNet(nn.Module):
+    """Default model: shared or separate MLP encoders + pi / vf heads
+    (reference: core/models/catalog.py:28 default MLP encoder + heads)."""
+
+    action_dim: int
+    hiddens: tuple = (256, 256)
+    activation: str = "tanh"
+    vf_share_layers: bool = False
+    dtype: Any = jnp.float32
+
+    def _encoder(self, x, name):
+        act = dict(tanh=nn.tanh, relu=nn.relu, swish=nn.swish)[self.activation]
+        for i, width in enumerate(self.hiddens):
+            x = nn.Dense(width, dtype=self.dtype, name=f"{name}_{i}")(x)
+            x = act(x)
+        return x
+
+    @nn.compact
+    def __call__(self, obs):
+        obs = obs.reshape(obs.shape[0], -1)
+        z_pi = self._encoder(obs, "pi")
+        z_vf = z_pi if self.vf_share_layers else self._encoder(obs, "vf")
+        # Small-init final layers stabilize early PPO updates.
+        pi_out = nn.Dense(
+            self.action_dim, dtype=self.dtype, name="pi_head",
+            kernel_init=nn.initializers.variance_scaling(0.01, "fan_in", "truncated_normal"),
+        )(z_pi)
+        vf_out = nn.Dense(1, dtype=self.dtype, name="vf_head")(z_vf)
+        return pi_out, vf_out[..., 0]
+
+
+class QNet(nn.Module):
+    """Q(s, ·) head for value-based algorithms (DQN)."""
+
+    num_actions: int
+    hiddens: tuple = (256, 256)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.reshape(obs.shape[0], -1)
+        for i, width in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(width, dtype=self.dtype, name=f"q_{i}")(x))
+        return nn.Dense(self.num_actions, dtype=self.dtype, name="q_head")(x)
+
+
+class RLModule:
+    """Holds a flax net + params; forward passes are pure functions."""
+
+    def __init__(
+        self,
+        observation_space: Space,
+        action_space: Space,
+        model_config: Optional[dict] = None,
+        net: Optional[nn.Module] = None,
+        seed: int = 0,
+    ):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = dict(model_config or {})
+        self.dist_cls = get_dist_cls(action_space)
+        if net is None:
+            net = PiVfNet(
+                action_dim=dist_input_dim(action_space),
+                hiddens=tuple(self.model_config.get("fcnet_hiddens", (256, 256))),
+                activation=self.model_config.get("fcnet_activation", "tanh"),
+                vf_share_layers=bool(self.model_config.get("vf_share_layers", False)),
+            )
+        self.net = net
+        dummy = jnp.zeros((1,) + tuple(observation_space.shape), jnp.float32)
+        self.params = net.init(jax.random.PRNGKey(seed), dummy)
+
+    # -- pure forward passes (static over self.net) ----------------------
+
+    def apply(self, params, obs):
+        return self.net.apply(params, obs)
+
+    def forward_train(self, params, batch: Mapping) -> dict:
+        pi_out, vf = self.apply(params, batch[SampleBatch.OBS])
+        return {SampleBatch.ACTION_DIST_INPUTS: pi_out, SampleBatch.VF_PREDS: vf}
+
+    def forward_exploration(self, params, batch: Mapping, rng) -> dict:
+        pi_out, vf = self.apply(params, batch[SampleBatch.OBS])
+        dist = self.dist_cls(pi_out)
+        actions = dist.sample(rng)
+        return {
+            SampleBatch.ACTIONS: actions,
+            SampleBatch.ACTION_LOGP: dist.logp(actions),
+            SampleBatch.ACTION_DIST_INPUTS: pi_out,
+            SampleBatch.VF_PREDS: vf,
+        }
+
+    def forward_inference(self, params, batch: Mapping) -> dict:
+        pi_out, _ = self.apply(params, batch[SampleBatch.OBS])
+        return {SampleBatch.ACTIONS: self.dist_cls(pi_out).deterministic_sample()}
+
+    # -- state ------------------------------------------------------------
+
+    def get_state(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_state(self, params: Any) -> None:
+        self.params = params
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Serializable recipe for building an RLModule on a remote worker
+    (reference: SingleAgentRLModuleSpec, rl_module.py)."""
+
+    module_class: type = RLModule
+    observation_space: Optional[Space] = None
+    action_space: Optional[Space] = None
+    model_config: Optional[dict] = None
+    net_builder: Optional[Callable[[], nn.Module]] = None
+    seed: int = 0
+
+    def build(self) -> RLModule:
+        net = self.net_builder() if self.net_builder else None
+        return self.module_class(
+            self.observation_space,
+            self.action_space,
+            model_config=self.model_config,
+            net=net,
+            seed=self.seed,
+        )
+
+
+class MultiAgentRLModule:
+    """{module_id: RLModule} container (reference: marl_module.py)."""
+
+    def __init__(self, modules: Mapping[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def get_state(self) -> dict:
+        return {mid: m.get_state() for mid, m in self._modules.items()}
+
+    def set_state(self, state: Mapping) -> None:
+        for mid, s in state.items():
+            self._modules[mid].set_state(s)

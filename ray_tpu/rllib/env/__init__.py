@@ -1,0 +1,22 @@
+from ray_tpu.rllib.env.env import (
+    Env,
+    EnvContext,
+    MultiAgentEnv,
+    SyncVectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rllib.env.spaces import Box, Discrete, Space, flat_dim
+
+__all__ = [
+    "Box",
+    "Discrete",
+    "Env",
+    "EnvContext",
+    "MultiAgentEnv",
+    "Space",
+    "SyncVectorEnv",
+    "flat_dim",
+    "make_env",
+    "register_env",
+]

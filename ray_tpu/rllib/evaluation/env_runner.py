@@ -1,0 +1,197 @@
+"""EnvRunner — the rollout worker of the new stack.
+
+Reference: rllib/evaluation/rollout_worker.py:166 (sample :666) and the
+single-agent env-runner loop (evaluation/sampler.py:144 _env_runner,
+env_runner_v2.py:199), re-designed batched-first: B sub-envs stepped in
+lockstep, one jitted `forward_exploration` call per env step over the [B, obs]
+stack (fixed shapes → XLA compiles once; on CPU hosts this is still the fast
+path because action sampling is a single vectorized program, not B python
+policy calls).
+
+Produces SampleBatches with [T*B] rows grouped per sub-env, eps_id marking
+episode boundaries, and VALUES_BOOTSTRAPPED carrying V(s_next) at truncation /
+fragment cuts so GAE bootstraps correctly (postprocessing.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env import SyncVectorEnv, make_env
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class EnvRunner:
+    """Plain class; wrapped as an actor by EnvRunnerGroup (so it can also run
+    locally inside the Algorithm for `num_env_runners=0`)."""
+
+    def __init__(self, config, worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        num_envs = max(1, int(getattr(config, "num_envs_per_env_runner", 1)))
+        env_cfg = getattr(config, "env_config", None) or {}
+        self.vector_env = SyncVectorEnv(
+            [
+                (lambda i=i: make_env(config.env, env_cfg, worker_index=worker_index))
+                for i in range(num_envs)
+            ]
+        )
+        self.num_envs = num_envs
+        spec = RLModuleSpec(
+            observation_space=self.vector_env.observation_space,
+            action_space=self.vector_env.action_space,
+            model_config=dict(getattr(config, "model", None) or {}),
+            seed=(getattr(config, "seed", 0) or 0) + worker_index,
+        )
+        if getattr(config, "rl_module_spec", None) is not None:
+            spec = config.rl_module_spec
+        self.module = spec.build()
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._vf_fn = jax.jit(
+            lambda params, obs: self.module.apply(params, obs)[1]
+        )
+        seed = (getattr(config, "seed", 0) or 0) * 10007 + worker_index
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs, _ = self.vector_env.reset(seed=seed)
+        self._eps_id = np.arange(num_envs, dtype=np.int64) + num_envs * worker_index * 1_000_000
+        self._next_eps = self._eps_id.max() + 1
+        self._ep_return = np.zeros(num_envs, dtype=np.float64)
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self._episode_returns: list[float] = []
+        self._episode_lengths: list[int] = []
+        self._steps_sampled = 0
+        self._is_continuous = isinstance(self.vector_env.action_space, Box)
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
+        """Collect `num_steps` env steps per sub-env (rollout fragment)."""
+        T = int(
+            num_steps
+            or getattr(self.config, "rollout_fragment_length", None)
+            or 200
+        )
+        B = self.num_envs
+        cols: dict[str, list] = defaultdict(list)
+        for _ in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            obs = self._obs.astype(np.float32)
+            fwd = self._explore_fn(self.module.params, {SampleBatch.OBS: obs}, key)
+            actions = np.asarray(fwd[SampleBatch.ACTIONS])
+            env_actions = actions
+            if self._is_continuous:
+                env_actions = np.clip(
+                    actions,
+                    self.vector_env.action_space.low,
+                    self.vector_env.action_space.high,
+                )
+            next_obs, rewards, terms, truncs, infos = self.vector_env.step(env_actions)
+            cols[SampleBatch.OBS].append(obs)
+            cols[SampleBatch.ACTIONS].append(actions)
+            cols[SampleBatch.REWARDS].append(rewards)
+            cols[SampleBatch.TERMINATEDS].append(terms)
+            cols[SampleBatch.TRUNCATEDS].append(truncs)
+            cols[SampleBatch.ACTION_LOGP].append(
+                np.asarray(fwd[SampleBatch.ACTION_LOGP])
+            )
+            cols[SampleBatch.ACTION_DIST_INPUTS].append(
+                np.asarray(fwd[SampleBatch.ACTION_DIST_INPUTS])
+            )
+            cols[SampleBatch.VF_PREDS].append(np.asarray(fwd[SampleBatch.VF_PREDS]))
+            cols[SampleBatch.NEXT_OBS].append(next_obs.astype(np.float32))
+            cols[SampleBatch.EPS_ID].append(self._eps_id.copy())
+            # Truncation bootstrap: V(final_observation) where trunc hit.
+            boot = np.zeros(B, dtype=np.float32)
+            if truncs.any():
+                finals = np.stack(
+                    [
+                        np.asarray(
+                            infos[i].get("final_observation", next_obs[i]),
+                            dtype=np.float32,
+                        )
+                        for i in range(B)
+                    ]
+                )
+                vals = np.asarray(self._vf_fn(self.module.params, finals))
+                boot = np.where(truncs, vals, 0.0).astype(np.float32)
+            cols[SampleBatch.VALUES_BOOTSTRAPPED].append(boot)
+
+            self._ep_return += rewards
+            self._ep_len += 1
+            done = terms | truncs
+            for i in np.nonzero(done)[0]:
+                self._episode_returns.append(float(self._ep_return[i]))
+                self._episode_lengths.append(int(self._ep_len[i]))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+                self._eps_id[i] = self._next_eps
+                self._next_eps += 1
+            self._obs = next_obs
+        # Fragment cut: running episodes bootstrap from V(current obs).
+        running = ~(cols[SampleBatch.TERMINATEDS][-1] | cols[SampleBatch.TRUNCATEDS][-1])
+        if running.any():
+            vals = np.asarray(
+                self._vf_fn(self.module.params, self._obs.astype(np.float32))
+            )
+            last = cols[SampleBatch.VALUES_BOOTSTRAPPED][-1]
+            cols[SampleBatch.VALUES_BOOTSTRAPPED][-1] = np.where(
+                running, vals, last
+            ).astype(np.float32)
+
+        # [T, B, ...] -> per-env contiguous [B*T, ...] so eps_id is contiguous.
+        batch = SampleBatch(
+            {
+                k: np.stack(v).swapaxes(0, 1).reshape((B * T,) + np.asarray(v[0]).shape[1:])
+                for k, v in cols.items()
+            }
+        )
+        self._steps_sampled += batch.count
+        if getattr(self.config, "_compute_gae_on_runner", True):
+            batch = compute_gae_for_sample_batch(
+                batch,
+                gamma=getattr(self.config, "gamma", 0.99),
+                lambda_=getattr(self.config, "lambda_", 0.95),
+                use_gae=getattr(self.config, "use_gae", True),
+            )
+        return batch
+
+    # -- weights / metrics -------------------------------------------------
+
+    def set_weights(self, weights: Any) -> None:
+        self.module.set_state(weights)
+
+    def get_weights(self) -> Any:
+        return self.module.get_state()
+
+    def get_metrics(self) -> dict:
+        """Drain episode stats (reference: collect_metrics /
+        rollout_worker metrics queue)."""
+        out = {
+            "episode_returns": self._episode_returns,
+            "episode_lengths": self._episode_lengths,
+            "num_env_steps_sampled": self._steps_sampled,
+        }
+        self._episode_returns = []
+        self._episode_lengths = []
+        return out
+
+    def spaces(self) -> tuple:
+        return self.vector_env.observation_space, self.vector_env.action_space
+
+    def stop(self) -> None:
+        self.vector_env.close()
+
+    def ping(self) -> str:
+        return "pong"
+
+
+RemoteEnvRunner = ray_tpu.remote(EnvRunner)

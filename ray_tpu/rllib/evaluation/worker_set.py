@@ -1,0 +1,144 @@
+"""EnvRunnerGroup — the WorkerSet of env-runner actors, fault-tolerant.
+
+Reference: rllib/evaluation/worker_set.py:80 (WorkerSet; sync_weights :356;
+fault-tolerant foreach_worker* :648-748) + rllib/utils/actor_manager.py:189
+(FaultTolerantActorManager). Failed runners are dropped from the active set
+and asynchronously recreated (restored from the latest weights), preserving
+the reference's "ignore_env_runner_failures / recreate_failed_env_runners"
+semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.rllib.evaluation.env_runner import EnvRunner, RemoteEnvRunner
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, concat_samples
+
+
+class EnvRunnerGroup:
+    def __init__(self, config, local: bool = True):
+        self.config = config
+        self.num_workers = int(getattr(config, "num_env_runners", 0) or 0)
+        self.local_runner: Optional[EnvRunner] = None
+        self._remote: dict[int, Any] = {}
+        self._weights: Any = None
+        if local or self.num_workers == 0:
+            self.local_runner = EnvRunner(config, worker_index=0)
+        for i in range(1, self.num_workers + 1):
+            self._remote[i] = self._make_remote(i)
+
+    def _make_remote(self, index: int):
+        opts = {"num_cpus": getattr(self.config, "num_cpus_per_env_runner", 1)}
+        return RemoteEnvRunner.options(
+            max_restarts=0, **opts
+        ).remote(self.config, index)
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
+        """Synchronous parallel sample across all runners (reference:
+        rllib/execution/rollout_ops.py:21 synchronous_parallel_sample)."""
+        if not self._remote:
+            return self.local_runner.sample(num_steps)
+        refs = {
+            idx: runner.sample.remote(num_steps)
+            for idx, runner in self._remote.items()
+        }
+        batches, failed = [], []
+        for idx, ref in refs.items():
+            try:
+                batches.append(ray_tpu.get(ref, timeout=300.0))
+            except Exception:
+                failed.append(idx)
+        self._handle_failures(failed)
+        if not batches:
+            raise RuntimeError("All env runners failed to sample")
+        return concat_samples(batches)
+
+    def sample_async(self, num_steps: Optional[int] = None) -> dict:
+        """Kick off sampling on every remote runner; {index: ObjectRef}."""
+        return {
+            idx: runner.sample.remote(num_steps)
+            for idx, runner in self._remote.items()
+        }
+
+    def _handle_failures(self, failed: list) -> None:
+        restore = getattr(self.config, "restart_failed_env_runners", True)
+        if not failed:
+            return
+        for idx in failed:
+            try:
+                ray_tpu.kill(self._remote[idx])
+            except Exception:
+                pass
+            del self._remote[idx]
+            if restore:
+                runner = self._make_remote(idx)
+                if self._weights is not None:
+                    runner.set_weights.remote(self._weights)
+                self._remote[idx] = runner
+
+    # -- weights ----------------------------------------------------------
+
+    def sync_weights(self, weights: Any) -> None:
+        """Broadcast learner weights to every runner. The weights ref is put
+        once and shared (reference worker_set.py:356 sync_weights puts the
+        weights into the object store once)."""
+        self._weights = weights
+        if self.local_runner is not None:
+            self.local_runner.set_weights(weights)
+        if self._remote:
+            ref = ray_tpu.put(weights)
+            for runner in self._remote.values():
+                runner.set_weights.remote(ref)
+
+    # -- metrics / map ----------------------------------------------------
+
+    def foreach_worker(self, fn_name: str, *args, local: bool = True) -> list:
+        out = []
+        if local and self.local_runner is not None:
+            out.append(getattr(self.local_runner, fn_name)(*args))
+        refs, failed = [], []
+        for idx, runner in self._remote.items():
+            refs.append((idx, getattr(runner, fn_name).remote(*args)))
+        for idx, ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=120.0))
+            except Exception:
+                failed.append(idx)
+        self._handle_failures(failed)
+        return out
+
+    def collect_metrics(self) -> dict:
+        """Aggregate drained episode stats across runners."""
+        import numpy as np
+
+        metrics = self.foreach_worker("get_metrics")
+        returns = [r for m in metrics for r in m["episode_returns"]]
+        lengths = [l for m in metrics for l in m["episode_lengths"]]
+        steps = sum(m["num_env_steps_sampled"] for m in metrics)
+        out = {
+            "num_env_steps_sampled_total": steps,
+            "episodes_this_iter": len(returns),
+        }
+        if returns:
+            out["episode_return_mean"] = float(np.mean(returns))
+            out["episode_return_max"] = float(np.max(returns))
+            out["episode_return_min"] = float(np.min(returns))
+            out["episode_len_mean"] = float(np.mean(lengths))
+        return out
+
+    def num_healthy_workers(self) -> int:
+        return len(self._remote) + (1 if self.local_runner else 0)
+
+    def stop(self) -> None:
+        if self.local_runner is not None:
+            self.local_runner.stop()
+        for runner in self._remote.values():
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+        self._remote = {}

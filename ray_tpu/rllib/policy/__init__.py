@@ -1,0 +1,7 @@
+from ray_tpu.rllib.policy.sample_batch import (
+    MultiAgentBatch,
+    SampleBatch,
+    concat_samples,
+)
+
+__all__ = ["MultiAgentBatch", "SampleBatch", "concat_samples"]

@@ -23,10 +23,8 @@ class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef (reference:
     serve/handle.py DeploymentResponse)."""
 
-    def __init__(self, ref: ObjectRef, router: "Router", replica_tag: str):
+    def __init__(self, ref: ObjectRef):
         self._ref = ref
-        self._router = router
-        self._replica_tag = replica_tag
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         from ray_tpu import api as ray
@@ -136,7 +134,7 @@ class Router:
             self._on_done(_tag)
 
         get_runtime().store.on_sealed(ref.id, _on_reply)
-        return DeploymentResponse(ref, self, tag)
+        return DeploymentResponse(ref)
 
     def _pick_replica(self, timeout_s: float = 30.0):
         deadline = time.time() + timeout_s

@@ -35,12 +35,14 @@ class WorkflowStorage:
     def __init__(self, workflow_id: str, base: Optional[str] = None):
         self.workflow_id = workflow_id
         self.root = os.path.join(base or get_base(), workflow_id)
-        os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
 
     # -- atomic helpers -------------------------------------------------
 
     def _write(self, rel: str, data: bytes) -> None:
+        # Directories are created on first write only, so read-side API calls
+        # (get_status/get_metadata) never create or resurrect workflow dirs.
         path = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         try:
             with os.fdopen(fd, "wb") as f:

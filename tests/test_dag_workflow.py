@@ -147,3 +147,21 @@ def test_workflow_delete(ray_start_regular, wf_storage):
     workflow.run(one.bind(), workflow_id="w4")
     workflow.delete("w4")
     assert workflow.get_status("w4") is None
+
+
+def test_dag_nested_container_args(ray_start_regular):
+    """DAG nodes nested in list/dict args are executed and substituted
+    (regression: _children/_resolve scan containers)."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode  # noqa: F401
+
+    @ray_tpu.remote
+    def const(x):
+        return x
+
+    @ray_tpu.remote
+    def combine(parts, named):
+        return sum(ray_tpu.get(list(parts))) + ray_tpu.get(named["extra"])
+
+    dag = combine.bind([const.bind(1), const.bind(2)], {"extra": const.bind(10)})
+    assert ray_tpu.get(dag.execute()) == 13

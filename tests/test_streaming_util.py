@@ -267,3 +267,29 @@ def test_actor_pool_task_error_returns_actor(ray_start_regular):
         pool.get_next()
     pool.submit(lambda a, v: a.work.remote(v), False)
     assert pool.get_next() == "ok"
+
+
+def test_streaming_generator_killed_actor_does_not_hang(ray_start_regular):
+    """Killing the actor while a streaming task is queued/running must finish
+    the stream with ActorDiedError, not hang the reader (regression: every
+    _finalize path now closes the stream)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.exceptions import ActorDiedError
+
+    @ray_tpu.remote
+    class Gen:
+        def slow_stream(self):
+            for i in range(100):
+                time.sleep(0.05)
+                yield i
+
+    actor = Gen.options(max_restarts=0).remote()
+    gen = actor.slow_stream.options(num_returns="streaming").remote()
+    # Let the generator start, then kill mid-stream.
+    time.sleep(0.2)
+    ray_tpu.kill(actor)
+    with pytest.raises(ActorDiedError):
+        for _ in range(200):
+            ray_tpu.get(next(gen), timeout=10.0)
