@@ -12,11 +12,18 @@ feeding format for `iter_batches(batch_format="numpy")` → `jax.device_put`.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Union
 
 import numpy as np
+
+# pyarrow table construction from numpy segfaults sporadically when entered
+# from many worker threads at once (observed with pa.Table.from_pydict under
+# the thread-pool executor); arrow conversions are cheap relative to the IO
+# they precede, so serialize them.
+_ARROW_BUILD_LOCK = threading.Lock()
 
 Block = Any  # list | pyarrow.Table | pandas.DataFrame | dict[str, np.ndarray]
 
@@ -87,9 +94,10 @@ class BlockAccessor:
     def to_arrow(self):
         import pyarrow as pa
 
-        return pa.Table.from_pydict(
-            {k: v for k, v in self.to_numpy_dict().items()}
-        )
+        with _ARROW_BUILD_LOCK:
+            return pa.Table.from_pydict(
+                {k: v for k, v in self.to_numpy_dict().items()}
+            )
 
     def take_columns(self, keys) -> Block:
         d = self.to_numpy_dict()

@@ -152,6 +152,11 @@ class AlgorithmConfig:
     def get_default_learner_class(self) -> Type[Learner]:
         raise NotImplementedError
 
+    def get_learner_slice_unit(self) -> int:
+        """Row-group size that must not be split when sharding a train batch
+        across remote learners (fragment-structured losses override)."""
+        return 1
+
     def build_learner_group(self, spec: RLModuleSpec) -> LearnerGroup:
         learner_cls = self.get_default_learner_class()
         cfg = self
@@ -164,6 +169,7 @@ class AlgorithmConfig:
             num_learners=self.num_learners,
             num_cpus_per_learner=self.num_cpus_per_learner,
             num_tpus_per_learner=self.num_tpus_per_learner,
+            slice_unit=self.get_learner_slice_unit(),
         )
 
 
@@ -224,7 +230,10 @@ class Algorithm(Trainable):
         train_batch = concat_samples(batches)
         self._env_steps_total += train_batch.count
         learner_results = self.learner_group.update(train_batch)
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(),
+            global_vars={"timestep": self._env_steps_total},
+        )
         return dict(learner_results)
 
     # -- checkpointing -----------------------------------------------------
@@ -245,7 +254,7 @@ class Algorithm(Trainable):
 
     def get_module(self):
         if self.learner_group.is_local:
-            return self.learner_group._local.module
+            return self.learner_group.local_learner.module
         return None
 
     def compute_single_action(self, obs, explore: bool = False):
@@ -258,7 +267,11 @@ class Algorithm(Trainable):
             import jax
 
             runner._rng, key = jax.random.split(runner._rng)
-            out = runner._explore_fn(runner.module.params, {SampleBatch.OBS: obs}, key)
+            fwd_in = {SampleBatch.OBS: obs}
+            fwd_in.update(
+                runner.module.exploration_inputs(self._env_steps_total)
+            )
+            out = runner._explore_fn(runner.module.params, fwd_in, key)
         else:
             out = runner.module.forward_inference(
                 runner.module.params, {SampleBatch.OBS: obs}

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig, DQNLearner, DQNModule
+
+__all__ = ["DQN", "DQNConfig", "DQNLearner", "DQNModule"]

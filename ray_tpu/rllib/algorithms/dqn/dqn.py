@@ -1,0 +1,240 @@
+"""DQN — off-policy Q-learning with replay and a target network.
+
+Reference: rllib/algorithms/dqn/dqn.py (DQNConfig, training_step with
+store→sample→train→target-sync loop) and dqn_torch_policy loss (double-Q,
+huber TD). The target network rides the Learner's `extra_train_state` pytree,
+so a target sync is a host-side copy — no re-trace of the jitted update.
+Epsilon-greedy exploration enters the runner's jitted forward as a traced
+input computed from a host-side linear schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import QNet, RLModule, RLModuleSpec
+from ray_tpu.rllib.env.spaces import Discrete
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+class DQNModule(RLModule):
+    """Q-network module: greedy inference, epsilon-greedy exploration."""
+
+    has_value_head = False
+
+    def __init__(self, observation_space, action_space, model_config=None,
+                 net=None, seed: int = 0):
+        assert isinstance(action_space, Discrete), "DQN needs a Discrete space"
+        model_config = dict(model_config or {})
+        if net is None:
+            net = QNet(
+                num_actions=action_space.n,
+                hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
+            )
+        super().__init__(observation_space, action_space, model_config, net, seed)
+        self.epsilon_initial = float(model_config.get("epsilon_initial", 1.0))
+        self.epsilon_final = float(model_config.get("epsilon_final", 0.05))
+        self.epsilon_timesteps = int(model_config.get("epsilon_timesteps", 10_000))
+
+    def exploration_inputs(self, timestep: int) -> dict:
+        frac = min(1.0, timestep / max(1, self.epsilon_timesteps))
+        eps = self.epsilon_initial + frac * (self.epsilon_final - self.epsilon_initial)
+        return {"epsilon": np.float32(eps)}
+
+    def forward_train(self, params, batch) -> dict:
+        return {"q_values": self.apply(params, batch[SampleBatch.OBS])}
+
+    def forward_exploration(self, params, batch, rng) -> dict:
+        q = self.apply(params, batch[SampleBatch.OBS])
+        greedy = jnp.argmax(q, axis=-1)
+        key_u, key_a = jax.random.split(rng)
+        random_actions = jax.random.randint(key_a, greedy.shape, 0, q.shape[-1])
+        explore = jax.random.uniform(key_u, greedy.shape) < batch["epsilon"]
+        return {SampleBatch.ACTIONS: jnp.where(explore, random_actions, greedy)}
+
+    def forward_inference(self, params, batch) -> dict:
+        q = self.apply(params, batch[SampleBatch.OBS])
+        return {SampleBatch.ACTIONS: jnp.argmax(q, axis=-1)}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.gamma = 0.99
+        self.target_network_update_freq = 500  # env steps
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.replay_buffer_config = {
+            "type": "ReplayBuffer",
+            "capacity": 50_000,
+            "alpha": 0.6,
+            "beta": 0.4,
+        }
+        self.double_q = True
+        self.n_step = 1
+        self.training_intensity: Optional[float] = None  # updates per env step
+        self.epsilon = [1.0, 0.05]
+        self.epsilon_timesteps = 10_000
+        self.rollout_fragment_length = 4
+        self._compute_gae_on_runner = False
+
+    def get_default_learner_class(self):
+        return DQNLearner
+
+    def get_rollout_fragment_length(self) -> int:
+        return self.rollout_fragment_length or 4
+
+
+class DQNLearner(Learner):
+    def initial_extra_state(self):
+        # Target network starts as a copy of the online params.
+        return {"target": jax.tree_util.tree_map(jnp.array, self.module.params)}
+
+    def compute_loss(self, params, batch, rng, extra=None):
+        cfg = self.config
+        q_all = self.module.apply(params, batch[SampleBatch.OBS])
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        q_sel = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+
+        q_next_target = self.module.apply(extra["target"], batch[SampleBatch.NEXT_OBS])
+        if cfg.double_q:
+            q_next_online = self.module.apply(params, batch[SampleBatch.NEXT_OBS])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+        gamma_n = cfg.gamma ** cfg.n_step
+        target = batch[SampleBatch.REWARDS] + gamma_n * not_done * jax.lax.stop_gradient(q_next)
+        td_error = q_sel - target
+        huber = jnp.where(
+            jnp.abs(td_error) < 1.0,
+            0.5 * td_error**2,
+            jnp.abs(td_error) - 0.5,
+        )
+        weights = batch.get("weights")
+        loss = jnp.mean(huber * weights) if weights is not None else jnp.mean(huber)
+        return loss, {
+            "qf_mean": jnp.mean(q_sel),
+            "td_error_abs": jnp.mean(jnp.abs(td_error)),
+            # Per-sample TD errors for prioritized replay; popped host-side.
+            "td_error": td_error,
+        }
+
+    def update(self, batch) -> dict:
+        """Single-pass update keeping per-sample TD errors (for priority
+        updates) out of the scalar metric averaging."""
+        assert self._built
+        if self._update_fn is None:
+            self._update_fn = self._make_update_fn()
+        from ray_tpu.rllib.core.learner import _to_device_batch
+
+        self._rng, key = jax.random.split(self._rng)
+        self.module.params, self._opt_state, metrics = self._update_fn(
+            self.module.params,
+            self._opt_state,
+            self.extra_train_state,
+            _to_device_batch(batch),
+            key,
+        )
+        td = np.asarray(jax.device_get(metrics.pop("td_error")))
+        out = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+        out["td_error_per_sample"] = td
+        return out
+
+    def sync_target(self) -> None:
+        self.extra_train_state = {
+            "target": jax.tree_util.tree_map(jnp.array, self.module.params)
+        }
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        # Epsilon schedule flows to runners via the module spec's model config.
+        model = dict(cfg.model)
+        eps = cfg.epsilon if isinstance(cfg.epsilon, (list, tuple)) else [cfg.epsilon, cfg.epsilon]
+        model.setdefault("epsilon_initial", eps[0])
+        model.setdefault("epsilon_final", eps[-1])
+        model.setdefault("epsilon_timesteps", cfg.epsilon_timesteps)
+        cfg.model = model
+        if cfg.rl_module_spec is None:
+            # Build spaces from a probe env so the spec uses DQNModule.
+            from ray_tpu.rllib.env.env import make_env
+
+            probe = make_env(cfg.env, cfg.env_config)
+            cfg.rl_module_spec = RLModuleSpec(
+                module_class=DQNModule,
+                observation_space=probe.observation_space,
+                action_space=probe.action_space,
+                model_config=model,
+                seed=cfg.seed or 0,
+            )
+            probe.close()
+        super().setup(config)
+        buf_cfg = dict(cfg.replay_buffer_config)
+        buf_type = buf_cfg.pop("type", "ReplayBuffer")
+        if buf_type in ("PrioritizedReplayBuffer", "prioritized"):
+            self.replay_buffer = PrioritizedReplayBuffer(
+                capacity=buf_cfg.get("capacity", 50_000),
+                alpha=buf_cfg.get("alpha", 0.6),
+                beta=buf_cfg.get("beta", 0.4),
+                seed=cfg.seed,
+            )
+        else:
+            self.replay_buffer = ReplayBuffer(
+                capacity=buf_cfg.get("capacity", 50_000), seed=cfg.seed
+            )
+        self._steps_since_target_sync = 0
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        rollout = self.env_runner_group.sample(cfg.get_rollout_fragment_length())
+        self.replay_buffer.add(rollout)
+        self._env_steps_total += rollout.count
+        self._steps_since_target_sync += rollout.count
+
+        results = {"replay_buffer_size": len(self.replay_buffer)}
+        if self._env_steps_total >= cfg.num_steps_sampled_before_learning_starts:
+            # Updates per sampled step; default one update per rollout.
+            intensity = cfg.training_intensity or (1.0 / rollout.count)
+            num_updates = max(1, int(round(intensity * rollout.count)))
+            for _ in range(num_updates):
+                train_batch = self.replay_buffer.sample(cfg.train_batch_size)
+                metrics = self.learner_group.update(train_batch)
+                # Local learners return "td_error_per_sample"; remote-learner
+                # mode concatenates the loss's "td_error" array across shards.
+                td = metrics.pop("td_error_per_sample", None)
+                if td is None:
+                    td = metrics.pop("td_error", None)
+                if td is not None and isinstance(
+                    self.replay_buffer, PrioritizedReplayBuffer
+                ):
+                    idx = np.asarray(train_batch["batch_indexes"])[: len(td)]
+                    self.replay_buffer.update_priorities(idx, td)
+                results.update(
+                    {k: v for k, v in metrics.items() if np.ndim(v) == 0}
+                )
+            if self._steps_since_target_sync >= cfg.target_network_update_freq:
+                self.learner_group.foreach_learner("sync_target")
+                self._steps_since_target_sync = 0
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights(),
+                global_vars={"timestep": self._env_steps_total},
+            )
+        return results
+

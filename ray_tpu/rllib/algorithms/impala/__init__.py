@@ -1,0 +1,8 @@
+from ray_tpu.rllib.algorithms.impala.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+)
+from ray_tpu.rllib.algorithms.impala import vtrace
+
+__all__ = ["IMPALA", "IMPALAConfig", "IMPALALearner", "vtrace"]

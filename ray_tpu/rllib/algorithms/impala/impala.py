@@ -1,0 +1,179 @@
+"""IMPALA — asynchronous sampling with V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/impala.py (:554 config, :687 training_step:
+async sample ObjectRefs → aggregation → learner; learner-thread overlap). The
+re-design keeps the async skeleton as actor-space logic: every remote runner
+always has one sample() in flight; the driver consumes whichever fragments are
+ready (ray_tpu.wait), updates the learner with V-trace (off-policy by one-ish
+weight version, exactly IMPALA's regime), and pushes fresh weights only to the
+runners it just drained — the aggregator-tree behavior at single-learner scale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import vtrace
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, concat_samples
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or IMPALA)
+        self.lr = 5e-4
+        self.train_batch_size = 500
+        self.rollout_fragment_length = 50
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_pg_rho_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.num_epochs = 1
+        self.minibatch_size = None  # one pass over the whole train batch
+        self._compute_gae_on_runner = False  # V-trace runs in the loss
+
+    def get_default_learner_class(self):
+        return IMPALALearner
+
+    def get_learner_slice_unit(self) -> int:
+        return int(self.rollout_fragment_length or 50)
+
+
+class IMPALALearner(Learner):
+    """V-trace actor-critic loss over time-major reshaped fragments."""
+
+    # Rows are fragment-ordered; shuffling would scramble trajectories.
+    shuffle_minibatches = False
+
+    def compute_loss(self, params, batch, rng, extra=None):
+        cfg = self.config
+        T = int(cfg.rollout_fragment_length or 50)
+        obs = batch[SampleBatch.OBS]
+        N = obs.shape[0] // T  # fragments (each a contiguous per-env slice)
+
+        def tm(x):  # [N*T, ...] -> time-major [T, N, ...]
+            return x.reshape((N, T) + x.shape[1:]).swapaxes(0, 1)
+
+        fwd = self.module.forward_train(params, batch)
+        dist = self.module.dist_cls(fwd[SampleBatch.ACTION_DIST_INPUTS])
+        target_logp = dist.logp(batch[SampleBatch.ACTIONS])
+        entropy = dist.entropy()
+        values = fwd[SampleBatch.VF_PREDS]
+
+        log_rhos = tm(target_logp - batch[SampleBatch.ACTION_LOGP])
+        dones = jnp.logical_or(
+            batch[SampleBatch.TERMINATEDS], batch[SampleBatch.TRUNCATEDS]
+        ).astype(jnp.float32)
+        discounts = tm(cfg.gamma * (1.0 - dones))
+        rewards = tm(batch[SampleBatch.REWARDS])
+        values_tm = tm(values)
+        # Bootstrap from V(next_obs of each fragment's last step).
+        next_obs_tm = tm(batch[SampleBatch.NEXT_OBS])
+        _, bootstrap = self.module.apply(params, next_obs_tm[-1])
+
+        vt = vtrace.from_importance_weights(
+            log_rhos=log_rhos,
+            discounts=discounts,
+            rewards=rewards,
+            values=values_tm,
+            bootstrap_value=jax.lax.stop_gradient(bootstrap),
+            clip_rho_threshold=cfg.vtrace_clip_rho_threshold,
+            clip_pg_rho_threshold=cfg.vtrace_clip_pg_rho_threshold,
+        )
+        pg_loss = -jnp.mean(tm(target_logp) * vt.pg_advantages)
+        vf_loss = 0.5 * jnp.mean((values_tm - vt.vs) ** 2)
+        entropy_mean = jnp.mean(entropy)
+        total = pg_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy_mean
+        return total, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy_mean,
+            "mean_rho": jnp.mean(jnp.exp(log_rhos)),
+        }
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        self._in_flight: dict[int, object] = {}
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        group = self.env_runner_group
+        frag = int(cfg.rollout_fragment_length or 50)
+
+        if not group.remote_runners():
+            # Synchronous fallback (num_env_runners=0): still V-trace, just
+            # on-policy — the reference's local-mode IMPALA does the same.
+            batches = []
+            count = 0
+            while count < cfg.train_batch_size:
+                b = group.local_runner.sample(frag)
+                batches.append(b)
+                count += b.count
+            train_batch = concat_samples(batches)
+            self._env_steps_total += train_batch.count
+            results = self.learner_group.update(train_batch)
+            group.sync_weights(
+                self.learner_group.get_weights(),
+                global_vars={"timestep": self._env_steps_total},
+            )
+            return dict(results)
+
+        # Keep one sample() in flight per runner.
+        for idx, runner in group.remote_runners().items():
+            if idx not in self._in_flight:
+                self._in_flight[idx] = runner.sample.remote(frag)
+
+        batches = []
+        drained: list[int] = []
+        count = 0
+        while count < cfg.train_batch_size:
+            refs = {ref: idx for idx, ref in self._in_flight.items()}
+            if not refs:
+                break
+            ready, _ = ray_tpu.wait(list(refs.keys()), num_returns=1, timeout=120.0)
+            if not ready:
+                break
+            for ref in ready:
+                idx = refs[ref]
+                del self._in_flight[idx]
+                try:
+                    batch = ray_tpu.get(ref)
+                except Exception:
+                    group.handle_failures([idx])
+                    continue
+                batches.append(batch)
+                count += batch.count
+                drained.append(idx)
+                # Immediately resubmit so the runner never idles; it still
+                # has its previous weights (V-trace absorbs the staleness).
+                runner = group.remote_runners().get(idx)
+                if runner is not None:
+                    self._in_flight[idx] = runner.sample.remote(frag)
+        if not batches:
+            raise RuntimeError("no rollout fragments received")
+        train_batch = concat_samples(batches)
+        self._env_steps_total += train_batch.count
+        results = self.learner_group.update(train_batch)
+
+        # Push fresh weights to drained runners only (broadcast-on-consume).
+        group.sync_weights(
+            self.learner_group.get_weights(),
+            global_vars={"timestep": self._env_steps_total},
+            to=sorted(set(drained)),
+        )
+        return dict(results)
+
+    def cleanup(self) -> None:
+        self._in_flight = {}
+        super().cleanup()

@@ -1,0 +1,59 @@
+"""V-trace off-policy correction (IMPALA, Espeholt et al. 2018).
+
+Reference: rllib/algorithms/impala/vtrace_torch.py (from_importance_weights).
+Pure-jax, time-major [T, B] inputs, computed with a reversed lax.scan so it
+lives inside the jitted loss — the XLA-friendly form of the reference's
+python loop over time steps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceReturns(NamedTuple):
+    vs: jnp.ndarray  # [T, B] value targets
+    pg_advantages: jnp.ndarray  # [T, B] policy-gradient advantages
+
+
+def from_importance_weights(
+    log_rhos: jnp.ndarray,  # [T, B] log(pi_target / pi_behavior)
+    discounts: jnp.ndarray,  # [T, B] gamma * (1 - done)
+    rewards: jnp.ndarray,  # [T, B]
+    values: jnp.ndarray,  # [T, B] V(s_t) under the target policy
+    bootstrap_value: jnp.ndarray,  # [B] V(s_{T})
+    clip_rho_threshold: float = 1.0,
+    clip_pg_rho_threshold: float = 1.0,
+) -> VTraceReturns:
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
+    cs = jnp.minimum(1.0, rhos)
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0
+    )
+    deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
+
+    def scan_fn(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs),
+        reverse=True,
+    )
+    vs = vs_minus_v + values
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho_threshold, rhos)
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * vs_t_plus_1 - values
+    )
+    return VTraceReturns(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+    )

@@ -47,7 +47,7 @@ class PPOLearner(Learner):
         super().build()
         self._kl_coeff = float(getattr(self.config, "kl_coeff", 0.2))
 
-    def compute_loss(self, params, batch, rng):
+    def compute_loss(self, params, batch, rng, extra=None):
         cfg = self.config
         module = self.module
         fwd = module.forward_train(params, batch)

@@ -36,6 +36,10 @@ def _to_device_batch(batch: Mapping) -> dict:
 class Learner:
     """Owns module params + optax state; runs the jitted update."""
 
+    # Subclasses whose loss depends on intra-batch row order (V-trace
+    # fragments) set this False; minibatches then iterate in input order.
+    shuffle_minibatches = True
+
     def __init__(
         self,
         module_spec: RLModuleSpec,
@@ -60,7 +64,14 @@ class Learner:
         self.module = self.module_spec.build()
         self.optimizer = self.configure_optimizer()
         self._opt_state = self.optimizer.init(self.module.params)
+        # Read-only pytree fed into the jitted loss as a traced input
+        # (target networks etc.) — mutated host-side in after_update without
+        # forcing a re-trace.
+        self.extra_train_state = self.initial_extra_state()
         self._built = True
+
+    def initial_extra_state(self) -> Any:
+        return {}
 
     def configure_optimizer(self) -> optax.GradientTransformation:
         lr = getattr(self.config, "lr", 5e-4) if self.config else 5e-4
@@ -73,7 +84,9 @@ class Learner:
 
     # -- algorithm hook ----------------------------------------------------
 
-    def compute_loss(self, params, batch: Mapping, rng) -> Tuple[jnp.ndarray, dict]:
+    def compute_loss(
+        self, params, batch: Mapping, rng, extra=None
+    ) -> Tuple[jnp.ndarray, dict]:
         raise NotImplementedError
 
     # -- update path -------------------------------------------------------
@@ -81,10 +94,10 @@ class Learner:
     def _make_update_fn(self):
         optimizer = self.optimizer
 
-        def update_step(params, opt_state, batch, rng):
+        def update_step(params, opt_state, extra, batch, rng):
             (loss, metrics), grads = jax.value_and_grad(
                 self.compute_loss, has_aux=True
-            )(params, batch, rng)
+            )(params, batch, rng, extra)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             metrics = dict(metrics)
@@ -101,7 +114,13 @@ class Learner:
             batch_sharding = NamedSharding(mesh, P(data_axes))
             jitted = jax.jit(
                 update_step,
-                in_shardings=(replicated, replicated, batch_sharding, replicated),
+                in_shardings=(
+                    replicated,
+                    replicated,
+                    replicated,
+                    batch_sharding,
+                    replicated,
+                ),
                 out_shardings=(replicated, replicated, replicated),
                 donate_argnums=(0, 1),
             )
@@ -119,11 +138,17 @@ class Learner:
         minibatch_size = getattr(cfg, "minibatch_size", None) or batch.count
         num_epochs = getattr(cfg, "num_epochs", 1) or 1
         all_metrics = []
-        for mb in batch.minibatches(minibatch_size, num_epochs=num_epochs):
+        for mb in batch.minibatches(
+            minibatch_size, num_epochs=num_epochs, shuffle=self.shuffle_minibatches
+        ):
             self._rng, key = jax.random.split(self._rng)
             device_batch = _to_device_batch(mb)
             self.module.params, self._opt_state, metrics = self._update_fn(
-                self.module.params, self._opt_state, device_batch, key
+                self.module.params,
+                self._opt_state,
+                self.extra_train_state,
+                device_batch,
+                key,
             )
             all_metrics.append(metrics)
         out = {
@@ -142,17 +167,23 @@ class Learner:
         assert self._built
         if self._grad_fn is None:
             self._grad_fn = jax.jit(
-                lambda params, b, rng: jax.value_and_grad(
+                lambda params, extra, b, rng: jax.value_and_grad(
                     self.compute_loss, has_aux=True
-                )(params, b, rng)
+                )(params, b, rng, extra)
             )
         self._rng, key = jax.random.split(self._rng)
         (loss, metrics), grads = self._grad_fn(
-            self.module.params, _to_device_batch(batch), key
+            self.module.params, self.extra_train_state, _to_device_batch(batch), key
         )
         metrics = dict(metrics)
         metrics["total_loss"] = loss
-        return grads, {k: float(jax.device_get(v)) for k, v in metrics.items()}
+        out = {}
+        for k, v in metrics.items():
+            v = jax.device_get(v)
+            # Scalars stay floats; per-sample diagnostics (td errors) pass
+            # through as arrays for the LearnerGroup to concatenate.
+            out[k] = float(v) if np.ndim(v) == 0 else np.asarray(v)
+        return grads, out
 
     def apply_gradients(self, grads: Any) -> None:
         assert self._built
@@ -173,8 +204,10 @@ class Learner:
         return {
             "weights": jax.device_get(self.module.params),
             "opt_state": jax.device_get(self._opt_state),
+            "extra": jax.device_get(self.extra_train_state),
         }
 
     def set_state(self, state: Mapping) -> None:
         self.module.params = state["weights"]
         self._opt_state = state["opt_state"]
+        self.extra_train_state = state.get("extra", self.extra_train_state)

@@ -51,6 +51,10 @@ class _LearnerActor:
     def set_state(self, state):
         self.learner.set_state(state)
 
+    def call(self, method: str, *args):
+        """Generic dispatch for learner-subclass methods (target syncs etc.)."""
+        return getattr(self.learner, method)(*args)
+
 
 def _average_grads(grad_list):
     return jax.tree_util.tree_map(
@@ -65,8 +69,12 @@ class LearnerGroup:
         num_learners: int = 0,
         num_cpus_per_learner: float = 1,
         num_tpus_per_learner: float = 0,
+        slice_unit: int = 1,
     ):
         self._num_learners = num_learners
+        # Batch rows come in groups of `slice_unit` that must not be split
+        # across learners (IMPALA fragments of rollout_fragment_length rows).
+        self._slice_unit = max(1, int(slice_unit))
         self._workers = []
         self._local = None
         if num_learners == 0:
@@ -85,22 +93,49 @@ class LearnerGroup:
     def is_local(self) -> bool:
         return self._local is not None
 
+    @property
+    def local_learner(self):
+        return self._local
+
     def update(self, batch: SampleBatch) -> dict:
         if self.is_local:
             return self._local.update(batch)
-        # Shard the batch across learners; grad-average; apply everywhere.
+        # Shard the batch across learners on slice_unit boundaries;
+        # grad-average; apply everywhere.
         n = len(self._workers)
-        shard = max(1, batch.count // n)
-        shards = [batch.slice(i * shard, min((i + 1) * shard, batch.count)) for i in range(n)]
+        unit = self._slice_unit
+        num_units = batch.count // unit
+        units_per = max(1, num_units // n)
+        shards = []
+        for i in range(n):
+            start = i * units_per * unit
+            end = (i + 1) * units_per * unit if i < n - 1 else num_units * unit
+            if start < end:
+                shards.append(batch.slice(start, end))
+        workers = self._workers[: len(shards)]
         results = ray_tpu.get(
-            [w.compute_gradients.remote(s) for w, s in zip(self._workers, shards)]
+            [w.compute_gradients.remote(s) for w, s in zip(workers, shards)]
         )
         grads = _average_grads([g for g, _ in results])
         ray_tpu.get([w.apply_gradients.remote(grads) for w in self._workers])
         metric_dicts = [m for _, m in results]
-        return {
-            k: float(np.mean([m[k] for m in metric_dicts])) for k in metric_dicts[0]
-        }
+        out = {}
+        for k in metric_dicts[0]:
+            vals = [m[k] for m in metric_dicts]
+            if np.ndim(vals[0]) == 0:
+                out[k] = float(np.mean(vals))
+            else:
+                # Per-sample diagnostics (td errors) concatenate in shard
+                # order, which matches the batch's row order.
+                out[k] = np.concatenate([np.asarray(v) for v in vals])
+        return out
+
+    def foreach_learner(self, method: str, *args) -> list:
+        """Call a learner-subclass method on every learner (public dispatch;
+        algorithms must not reach into _local/_workers)."""
+        if self.is_local:
+            return [getattr(self._local, method)(*args)]
+        return ray_tpu.get([w.call.remote(method, *args) for w in self._workers])
 
     def get_weights(self) -> Any:
         if self.is_local:

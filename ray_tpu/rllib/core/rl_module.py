@@ -95,6 +95,15 @@ class RLModule:
         dummy = jnp.zeros((1,) + tuple(observation_space.shape), jnp.float32)
         self.params = net.init(jax.random.PRNGKey(seed), dummy)
 
+    # The default module is actor-critic shaped; value-free modules (DQN)
+    # set this False so runners skip bootstrap-value computation.
+    has_value_head = True
+
+    def exploration_inputs(self, timestep: int) -> Mapping:
+        """Extra host-computed arrays merged into the exploration forward's
+        batch (epsilon schedules etc.) — traced inputs, never retraces."""
+        return {}
+
     # -- pure forward passes (static over self.net) ----------------------
 
     def apply(self, params, obs):

@@ -56,8 +56,11 @@ class EnvRunner:
             spec = config.rl_module_spec
         self.module = spec.build()
         self._explore_fn = jax.jit(self.module.forward_exploration)
-        self._vf_fn = jax.jit(
-            lambda params, obs: self.module.apply(params, obs)[1]
+        self._has_vf = getattr(self.module, "has_value_head", True)
+        self._vf_fn = (
+            jax.jit(lambda params, obs: self.module.apply(params, obs)[1])
+            if self._has_vf
+            else None
         )
         seed = (getattr(config, "seed", 0) or 0) * 10007 + worker_index
         self._rng = jax.random.PRNGKey(seed)
@@ -69,6 +72,7 @@ class EnvRunner:
         self._episode_returns: list[float] = []
         self._episode_lengths: list[int] = []
         self._steps_sampled = 0
+        self._global_timestep = 0  # cluster-wide env steps, pushed by the algo
         self._is_continuous = isinstance(self.vector_env.action_space, Box)
 
     # -- sampling ----------------------------------------------------------
@@ -85,7 +89,15 @@ class EnvRunner:
         for _ in range(T):
             self._rng, key = jax.random.split(self._rng)
             obs = self._obs.astype(np.float32)
-            fwd = self._explore_fn(self.module.params, {SampleBatch.OBS: obs}, key)
+            fwd_in = {SampleBatch.OBS: obs}
+            # Module-specific exploration knobs (epsilon etc.) enter the
+            # jitted forward as traced inputs, so schedules never retrace.
+            # Schedules tick on the cluster-wide step count (broadcast with
+            # weight syncs, like the reference's global_vars), falling back
+            # to local steps before the first sync.
+            timestep = max(self._global_timestep, self._steps_sampled)
+            fwd_in.update(self.module.exploration_inputs(timestep))
+            fwd = self._explore_fn(self.module.params, fwd_in, key)
             actions = np.asarray(fwd[SampleBatch.ACTIONS])
             env_actions = actions
             if self._is_continuous:
@@ -100,34 +112,43 @@ class EnvRunner:
             cols[SampleBatch.REWARDS].append(rewards)
             cols[SampleBatch.TERMINATEDS].append(terms)
             cols[SampleBatch.TRUNCATEDS].append(truncs)
-            cols[SampleBatch.ACTION_LOGP].append(
-                np.asarray(fwd[SampleBatch.ACTION_LOGP])
-            )
-            cols[SampleBatch.ACTION_DIST_INPUTS].append(
-                np.asarray(fwd[SampleBatch.ACTION_DIST_INPUTS])
-            )
-            cols[SampleBatch.VF_PREDS].append(np.asarray(fwd[SampleBatch.VF_PREDS]))
-            cols[SampleBatch.NEXT_OBS].append(next_obs.astype(np.float32))
+            for key_, val in fwd.items():
+                if key_ != SampleBatch.ACTIONS:
+                    cols[key_].append(np.asarray(val))
+            # NEXT_OBS must be the transition's true successor state: at
+            # done steps the vector env auto-reset, so substitute the final
+            # observation (replay-based TD targets and V-trace bootstraps
+            # read this column across truncation boundaries).
+            done = terms | truncs
+            if done.any():
+                next_obs_rec = next_obs.copy()
+                for i in np.nonzero(done)[0]:
+                    fin = infos[i].get("final_observation")
+                    if fin is not None:
+                        next_obs_rec[i] = fin
+            else:
+                next_obs_rec = next_obs
+            cols[SampleBatch.NEXT_OBS].append(next_obs_rec.astype(np.float32))
             cols[SampleBatch.EPS_ID].append(self._eps_id.copy())
-            # Truncation bootstrap: V(final_observation) where trunc hit.
-            boot = np.zeros(B, dtype=np.float32)
-            if truncs.any():
-                finals = np.stack(
-                    [
-                        np.asarray(
-                            infos[i].get("final_observation", next_obs[i]),
-                            dtype=np.float32,
-                        )
-                        for i in range(B)
-                    ]
-                )
-                vals = np.asarray(self._vf_fn(self.module.params, finals))
-                boot = np.where(truncs, vals, 0.0).astype(np.float32)
-            cols[SampleBatch.VALUES_BOOTSTRAPPED].append(boot)
+            if self._vf_fn is not None:
+                # Truncation bootstrap: V(final_observation) where trunc hit.
+                boot = np.zeros(B, dtype=np.float32)
+                if truncs.any():
+                    finals = np.stack(
+                        [
+                            np.asarray(
+                                infos[i].get("final_observation", next_obs[i]),
+                                dtype=np.float32,
+                            )
+                            for i in range(B)
+                        ]
+                    )
+                    vals = np.asarray(self._vf_fn(self.module.params, finals))
+                    boot = np.where(truncs, vals, 0.0).astype(np.float32)
+                cols[SampleBatch.VALUES_BOOTSTRAPPED].append(boot)
 
             self._ep_return += rewards
             self._ep_len += 1
-            done = terms | truncs
             for i in np.nonzero(done)[0]:
                 self._episode_returns.append(float(self._ep_return[i]))
                 self._episode_lengths.append(int(self._ep_len[i]))
@@ -138,7 +159,7 @@ class EnvRunner:
             self._obs = next_obs
         # Fragment cut: running episodes bootstrap from V(current obs).
         running = ~(cols[SampleBatch.TERMINATEDS][-1] | cols[SampleBatch.TRUNCATEDS][-1])
-        if running.any():
+        if self._vf_fn is not None and running.any():
             vals = np.asarray(
                 self._vf_fn(self.module.params, self._obs.astype(np.float32))
             )
@@ -166,8 +187,13 @@ class EnvRunner:
 
     # -- weights / metrics -------------------------------------------------
 
-    def set_weights(self, weights: Any) -> None:
+    def set_weights(self, weights: Any, global_vars: Optional[dict] = None) -> None:
         self.module.set_state(weights)
+        if global_vars:
+            self._global_timestep = int(global_vars.get("timestep", 0))
+
+    def set_global_vars(self, global_vars: dict) -> None:
+        self._global_timestep = int(global_vars.get("timestep", 0))
 
     def get_weights(self) -> Any:
         return self.module.get_state()

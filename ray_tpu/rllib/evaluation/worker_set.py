@@ -82,17 +82,33 @@ class EnvRunnerGroup:
 
     # -- weights ----------------------------------------------------------
 
-    def sync_weights(self, weights: Any) -> None:
-        """Broadcast learner weights to every runner. The weights ref is put
-        once and shared (reference worker_set.py:356 sync_weights puts the
-        weights into the object store once)."""
+    def sync_weights(
+        self,
+        weights: Any,
+        global_vars: Optional[dict] = None,
+        to: Optional[list] = None,
+    ) -> None:
+        """Broadcast learner weights (and global vars like the cluster-wide
+        timestep) to runners. The weights ref is put once and shared
+        (reference worker_set.py:356). `to` restricts the push to specific
+        remote runner indices (IMPALA's broadcast-on-consume)."""
         self._weights = weights
         if self.local_runner is not None:
-            self.local_runner.set_weights(weights)
-        if self._remote:
+            self.local_runner.set_weights(weights, global_vars)
+        targets = self._remote if to is None else {
+            i: self._remote[i] for i in to if i in self._remote
+        }
+        if targets:
             ref = ray_tpu.put(weights)
-            for runner in self._remote.values():
-                runner.set_weights.remote(ref)
+            for runner in targets.values():
+                runner.set_weights.remote(ref, global_vars)
+
+    def remote_runners(self) -> dict:
+        """Live remote runners keyed by worker index (read-only view)."""
+        return dict(self._remote)
+
+    def handle_failures(self, failed: list) -> None:
+        self._handle_failures(failed)
 
     # -- metrics / map ----------------------------------------------------
 

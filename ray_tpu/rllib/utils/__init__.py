@@ -1,0 +1,6 @@
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+__all__ = ["PrioritizedReplayBuffer", "ReplayBuffer"]
