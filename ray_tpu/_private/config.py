@@ -47,6 +47,10 @@ class Config:
     # Copy (serialize/deserialize) task args even in the in-process engine so
     # mutation bugs surface in tests; direct zero-copy handoff when False.
     inproc_copy_args: bool = False
+    # Native shared-memory store (src/store/, plasma equivalent): objects at
+    # least this large go to shm; 0 disables. Requires the C++ lib to build.
+    native_store_threshold: int = 512 * 1024
+    native_store_enabled: bool = True
     # Worker pool
     prestart_workers: bool = True
     idle_worker_killing_time_s: float = 60.0

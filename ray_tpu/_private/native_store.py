@@ -1,0 +1,291 @@
+"""ctypes binding to the native shared-memory object store (src/store/).
+
+The plasma-client equivalent (reference: object_manager/plasma/client.h +
+_raylet.pyx plasma glue): workers map the node's shm segment and read sealed
+objects zero-copy. Serialization mirrors the reference's pickle5 out-of-band
+path (_private/serialization.py:18 split_buffer): the pickle stream and every
+out-of-band buffer land in one shm allocation, and deserialization wraps the
+mapped memory in memoryviews — numpy arrays come back as views onto shm
+(copy-once host→HBM at jax.device_put, SURVEY.md §7 hard part 3).
+
+Layout of one stored object:
+    [u64 pickle_len][u64 n_buffers][n × u64 buffer_len]
+    [pickle bytes][pad to 64][buf 0][pad to 64][buf 1]...
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Any, Optional
+
+import cloudpickle
+
+_ALIGN = 64
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_SOURCES = os.path.join(_REPO_ROOT, "src")
+_LIB_PATH = os.path.join(_LIB_SOURCES, "build", "libtpustore.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _load_lib():
+    """Load libtpustore.so, building it with make on first use."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                # One small translation unit; compiles in ~2s. The short
+                # timeout bounds init() latency on boxes without a toolchain.
+                subprocess.run(
+                    ["make", "-C", _LIB_SOURCES],
+                    check=True,
+                    capture_output=True,
+                    timeout=30,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"native shared-memory store unavailable ({exc!r}); large "
+                "objects stay in the in-process store",
+                RuntimeWarning,
+            )
+            _lib_failed = True
+            return None
+        P, U64, CP, I = (
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        )
+        lib.tps_open.restype = P
+        lib.tps_open.argtypes = [CP, U64, U64]
+        lib.tps_create.restype = I
+        lib.tps_create.argtypes = [P, CP, U64, ctypes.POINTER(P)]
+        lib.tps_seal.restype = I
+        lib.tps_seal.argtypes = [P, CP]
+        lib.tps_put.restype = I
+        lib.tps_put.argtypes = [P, CP, P, U64]
+        lib.tps_get.restype = I
+        lib.tps_get.argtypes = [P, CP, ctypes.POINTER(P), ctypes.POINTER(U64)]
+        lib.tps_release.restype = I
+        lib.tps_release.argtypes = [P, CP]
+        lib.tps_contains.restype = I
+        lib.tps_contains.argtypes = [P, CP]
+        lib.tps_delete.restype = I
+        lib.tps_delete.argtypes = [P, CP]
+        lib.tps_used.restype = U64
+        lib.tps_used.argtypes = [P]
+        lib.tps_capacity.restype = U64
+        lib.tps_capacity.argtypes = [P]
+        lib.tps_num_objects.restype = U64
+        lib.tps_num_objects.argtypes = [P]
+        lib.tps_close.restype = None
+        lib.tps_close.argtypes = [P]
+        lib.tps_destroy.restype = I
+        lib.tps_destroy.argtypes = [CP]
+        _lib = lib
+        return _lib
+
+
+def native_store_available() -> bool:
+    return _load_lib() is not None
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class NativeStoreFullError(MemoryError):
+    pass
+
+
+class NativeStore:
+    """One mapped shm segment; open the same name from any process on the node."""
+
+    def __init__(self, name: str, capacity: int = 1 << 30, slots: int = 0):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self.name = name.encode() if isinstance(name, str) else name
+        self._handle = lib.tps_open(self.name, capacity, slots)
+        if not self._handle:
+            raise RuntimeError(f"tps_open({name!r}) failed")
+        self._lock = threading.Lock()
+        # Objects the owner deleted while reader views still pinned them;
+        # the last reader's finalizer completes the delete (plasma defers
+        # reclamation the same way: eviction waits for client releases).
+        self._deferred_deletes: set = set()
+
+    # -- raw bytes API ----------------------------------------------------
+
+    @staticmethod
+    def _key(object_id: Any) -> bytes:
+        raw = object_id.binary() if hasattr(object_id, "binary") else bytes(object_id)
+        return raw.ljust(32, b"\0")[:32]
+
+    def put_raw(self, object_id, data: bytes) -> None:
+        rc = self._lib.tps_put(self._handle, self._key(object_id), data, len(data))
+        if rc == -2:
+            raise NativeStoreFullError(f"native store full putting {object_id}")
+        if rc == -3:
+            raise NativeStoreFullError("native store index full")
+        if rc not in (0, -1):  # -1 = already present (idempotent reseal)
+            raise RuntimeError(f"tps_put failed rc={rc}")
+
+    def get_raw(self, object_id, track: bool = False) -> Optional[memoryview]:
+        """Zero-copy view of the sealed payload (pins the object). With
+        track=True the pin is released automatically once every view derived
+        from the returned memoryview has been garbage collected."""
+        import weakref
+
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        rc = self._lib.tps_get(
+            self._handle, self._key(object_id), ctypes.byref(ptr), ctypes.byref(size)
+        )
+        if rc != 0:
+            return None
+        array_t = (ctypes.c_uint8 * size.value).from_address(ptr.value)
+        if track:
+            weakref.finalize(array_t, self._release_and_reap, self._key(object_id))
+        # ctypes arrays expose format '<B'; cast to plain 'B' so slicing and
+        # buffer-assignment work and pickle accepts the views.
+        return memoryview(array_t).cast("B")
+
+    def _release_and_reap(self, key: bytes) -> None:
+        try:
+            self._lib.tps_release(self._handle, key)
+            with self._lock:
+                deferred = key in self._deferred_deletes
+            if deferred and self._lib.tps_delete(self._handle, key) == 0:
+                with self._lock:
+                    self._deferred_deletes.discard(key)
+        except Exception:
+            pass  # interpreter shutdown
+
+    def pin(self, object_id) -> bool:
+        """Hold a refcount on a sealed object without materializing a view
+        (the owner-side pin preventing LRU eviction of live objects)."""
+        ptr = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        return (
+            self._lib.tps_get(
+                self._handle, self._key(object_id), ctypes.byref(ptr), ctypes.byref(size)
+            )
+            == 0
+        )
+
+    def unpin_and_delete(self, object_id) -> None:
+        """Owner-side delete: drop the owner pin; if readers still hold views,
+        defer reclamation to the last reader's finalizer."""
+        key = self._key(object_id)
+        self._lib.tps_release(self._handle, key)
+        rc = self._lib.tps_delete(self._handle, key)
+        if rc == -2:  # still pinned by reader views
+            with self._lock:
+                self._deferred_deletes.add(key)
+
+    def release(self, object_id) -> None:
+        self._lib.tps_release(self._handle, self._key(object_id))
+
+    def contains(self, object_id) -> bool:
+        return bool(self._lib.tps_contains(self._handle, self._key(object_id)))
+
+    def delete(self, object_id) -> bool:
+        return self._lib.tps_delete(self._handle, self._key(object_id)) == 0
+
+    # -- object API (pickle5 out-of-band) ---------------------------------
+
+    def put_object(self, object_id, value: Any) -> int:
+        """Serialize with out-of-band buffers into one shm allocation.
+        Returns stored size in bytes."""
+        buffers: list = []
+        pickled = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
+        raw_bufs = [b.raw() for b in buffers]
+        header = struct.pack(
+            f"<QQ{len(raw_bufs)}Q",
+            len(pickled),
+            len(raw_bufs),
+            *[len(b) for b in raw_bufs],
+        )
+        total = _pad(len(header)) + _pad(len(pickled))
+        for b in raw_bufs:
+            total += _pad(len(b))
+        out = ctypes.c_void_p()
+        rc = self._lib.tps_create(self._handle, self._key(object_id), total, ctypes.byref(out))
+        if rc == -1:  # already stored (task retry reseal) — idempotent
+            return total
+        if rc in (-2, -3):
+            raise NativeStoreFullError(f"native store full ({total} bytes)")
+        if rc != 0:
+            raise RuntimeError(f"tps_create failed rc={rc}")
+        dest = (ctypes.c_uint8 * total).from_address(out.value)
+        view = memoryview(dest).cast("B")
+        pos = 0
+        view[pos : pos + len(header)] = header
+        pos = _pad(len(header))
+        view[pos : pos + len(pickled)] = pickled
+        pos += _pad(len(pickled))
+        for b in raw_bufs:
+            view[pos : pos + len(b)] = b
+            pos += _pad(len(b))
+        self._lib.tps_seal(self._handle, self._key(object_id))
+        return total
+
+    def get_object(self, object_id, track: bool = True) -> tuple:
+        """Returns (found, value). Arrays in `value` are zero-copy views of
+        the shm segment; the object stays pinned until those views die
+        (track=True) or until an explicit `release` (track=False)."""
+        view = self.get_raw(object_id, track=track)
+        if view is None:
+            return False, None
+        pickle_len, n_bufs = struct.unpack_from("<QQ", view, 0)
+        buf_lens = struct.unpack_from(f"<{n_bufs}Q", view, 16)
+        pos = _pad(16 + 8 * n_bufs)
+        pickled = view[pos : pos + pickle_len]
+        pos += _pad(pickle_len)
+        bufs = []
+        for blen in buf_lens:
+            bufs.append(view[pos : pos + blen])
+            pos += _pad(blen)
+        value = cloudpickle.loads(pickled, buffers=bufs)
+        return True, value
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def used_bytes(self) -> int:
+        return int(self._lib.tps_used(self._handle))
+
+    def capacity(self) -> int:
+        return int(self._lib.tps_capacity(self._handle))
+
+    def num_objects(self) -> int:
+        return int(self._lib.tps_num_objects(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tps_close(self._handle)
+            self._handle = None
+
+    def destroy(self) -> None:
+        """Unlink the segment (node shutdown). Deliberately does NOT munmap:
+        zero-copy arrays handed to the user may outlive the runtime, and the
+        kernel reclaims the memory once the last mapping drops at process
+        exit. Unlinking just removes the name so the next session starts
+        fresh."""
+        self._lib.tps_destroy(self.name)

@@ -46,7 +46,16 @@ def _sizeof(value: Any) -> int:
 
 
 class _Entry:
-    __slots__ = ("value", "size", "sealed", "event", "freed", "last_access", "callbacks")
+    __slots__ = (
+        "value",
+        "size",
+        "sealed",
+        "event",
+        "freed",
+        "last_access",
+        "callbacks",
+        "in_native",
+    )
 
     def __init__(self):
         self.value = None
@@ -56,16 +65,29 @@ class _Entry:
         self.event = threading.Event()
         self.last_access = 0.0
         self.callbacks: list[Callable[[], None]] = []
+        self.in_native = False
 
 
 class InProcessStore:
-    """Thread-safe in-process object table with plasma-like lifecycle."""
+    """Thread-safe in-process object table with plasma-like lifecycle.
 
-    def __init__(self, memory_budget: int | None = None):
+    Large objects are delegated to the native shared-memory store
+    (src/store/tpu_store.cc via native_store.py) when one is attached:
+    the python table keeps the lifecycle (seal events, callbacks, budget),
+    shm keeps the bytes, and `get` deserializes zero-copy views."""
+
+    def __init__(
+        self,
+        memory_budget: int | None = None,
+        native=None,
+        native_threshold: int = 0,
+    ):
         self._lock = threading.Lock()
         self._entries: dict[ObjectID, _Entry] = {}
         self._budget = memory_budget
         self._used = 0
+        self._native = native
+        self._native_threshold = native_threshold if native is not None else 0
         # Objects the reference counter still holds references to may not be
         # evicted; the runtime installs this callback.
         self._pinned_check: Callable[[ObjectID], bool] = lambda oid: True
@@ -78,6 +100,17 @@ class InProcessStore:
     def seal(self, object_id: ObjectID, value: Any) -> None:
         """Create-and-seal in one step (the in-process store has no partial create)."""
         size = _sizeof(value)
+        in_native = False
+        if self._native_threshold and size >= self._native_threshold:
+            # Serialize into shm before taking the table lock (expensive);
+            # idempotent reseal is handled natively (-1 == exists).
+            try:
+                self._native.put_object(object_id, value)
+                self._native.pin(object_id)  # owner pin: not LRU-evictable
+                in_native = True
+                value = None
+            except MemoryError:
+                pass  # shm full: keep the python copy
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
@@ -85,6 +118,8 @@ class InProcessStore:
                 self._entries[object_id] = entry
             if entry.sealed:
                 # Idempotent reseal happens on task retry; keep first value.
+                if in_native:
+                    self._native.unpin_and_delete(object_id)
                 return
             if self._budget is not None and self._used + size > self._budget:
                 self._evict_locked(self._used + size - self._budget)
@@ -92,6 +127,7 @@ class InProcessStore:
             entry.size = size
             entry.sealed = True
             entry.freed = False
+            entry.in_native = in_native
             entry.last_access = time.monotonic()
             self._used += size
             entry.event.set()
@@ -123,7 +159,14 @@ class InProcessStore:
             if entry.freed:
                 raise ObjectFreedError(object_id, f"Object {object_id} was freed")
             entry.last_access = time.monotonic()
-            return entry.value
+            if not entry.in_native:
+                return entry.value
+        # Deserialize outside the lock; arrays come back as zero-copy views
+        # pinning the shm object until they are garbage collected.
+        found, value = self._native.get_object(object_id)
+        if not found:
+            raise ObjectLostError(object_id, f"Object {object_id} lost from shm")
+        return value
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -170,26 +213,38 @@ class InProcessStore:
     # -- delete path --------------------------------------------------------
 
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
+        natives = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.pop(oid, None)
                 if entry is not None and entry.sealed:
                     self._used -= entry.size
+                    if entry.in_native:
+                        natives.append(oid)
+        for oid in natives:
+            self._native.unpin_and_delete(oid)
 
     def free(self, object_ids: Iterable[ObjectID]) -> None:
         """Mark freed: later `get`s raise ObjectFreedError (ray.internal.free)."""
         fired: list[Callable[[], None]] = []
+        natives = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
                 if entry is not None:
                     if entry.sealed:
                         self._used -= entry.size
+                        entry.size = 0  # a later delete() must not re-subtract
+                    if entry.in_native:
+                        natives.append(oid)
+                        entry.in_native = False
                     entry.value = None
                     entry.freed = True
                     entry.event.set()
                     fired.extend(entry.callbacks)
                     entry.callbacks = []
+        for oid in natives:
+            self._native.unpin_and_delete(oid)
         for cb in fired:
             cb()
 
@@ -232,6 +287,11 @@ class InProcessStore:
                 break
             reclaimed += entry.size
             self._used -= entry.size
+            if entry.in_native:
+                # Called under the lock; the native delete takes only the shm
+                # mutex, no re-entry into this store.
+                self._native.unpin_and_delete(oid)
+                entry.in_native = False
             entry.value = None
             entry.freed = True
             entry.event.set()

@@ -20,6 +20,7 @@ Key invariants preserved from the reference:
 
 from __future__ import annotations
 
+import os
 import threading
 import traceback
 from collections import deque
@@ -143,7 +144,22 @@ class Runtime:
         self.namespace = namespace
         self.controller = Controller()
         budget = self.config.object_store_memory or _default_store_budget(self.config)
-        self.store = InProcessStore(memory_budget=budget)
+        self._native_store = None
+        if self.config.native_store_enabled and self.config.native_store_threshold:
+            from ray_tpu._private import native_store as native_mod
+
+            if native_mod.native_store_available():
+                try:
+                    self._native_store = native_mod.NativeStore(
+                        f"/ray_tpu_{os.getpid()}", capacity=budget
+                    )
+                except Exception:
+                    self._native_store = None
+        self.store = InProcessStore(
+            memory_budget=budget,
+            native=self._native_store,
+            native_threshold=self.config.native_store_threshold,
+        )
         self.refcount = ReferenceCounter(
             on_object_out_of_scope=lambda oid: self.store.delete([oid]),
         )
@@ -854,6 +870,12 @@ class Runtime:
         for engine in engines:
             engine.shutdown()
         self._background.shutdown(wait=False, cancel_futures=True)
+        if self._native_store is not None:
+            try:
+                self._native_store.destroy()
+            except Exception:
+                pass
+            self._native_store = None
         _RUNTIME = None
 
 

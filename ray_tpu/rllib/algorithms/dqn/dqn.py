@@ -116,8 +116,12 @@ class DQNLearner(Learner):
             q_next = jnp.max(q_next_target, axis=-1)
 
         not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
-        gamma_n = cfg.gamma ** cfg.n_step
-        target = batch[SampleBatch.REWARDS] + gamma_n * not_done * jax.lax.stop_gradient(q_next)
+        # n-step transitions carry their own per-row discount (windows near
+        # episode ends are shorter than n); 1-step batches fall back to gamma.
+        discount = batch.get("nstep_discount")
+        if discount is None:
+            discount = cfg.gamma
+        target = batch[SampleBatch.REWARDS] + discount * not_done * jax.lax.stop_gradient(q_next)
         td_error = q_sel - target
         huber = jnp.where(
             jnp.abs(td_error) < 1.0,
@@ -158,6 +162,45 @@ class DQNLearner(Learner):
         self.extra_train_state = {
             "target": jax.tree_util.tree_map(jnp.array, self.module.params)
         }
+
+
+def n_step_transitions(batch: SampleBatch, n: int, gamma: float) -> SampleBatch:
+    """Rewrite 1-step rows into n-step ones: REWARDS become the discounted
+    n-step sum, NEXT_OBS/TERMINATEDS come from the window's last step, and
+    "nstep_discount" carries gamma^window (windows shrink at episode ends).
+    Reference: rllib/utils/replay_buffers/utils.py (n-step adjustment applied
+    before adding to the buffer)."""
+    if n <= 1:
+        return batch
+    episodes = []
+    for ep in batch.split_by_episode():
+        T = ep.count
+        rewards = np.asarray(ep[SampleBatch.REWARDS], dtype=np.float32)
+        terms = np.asarray(ep[SampleBatch.TERMINATEDS])
+        next_obs = np.asarray(ep[SampleBatch.NEXT_OBS])
+        new_r = np.empty(T, np.float32)
+        new_disc = np.empty(T, np.float32)
+        new_next = np.empty_like(next_obs)
+        new_term = np.empty(T, bool)
+        for t in range(T):
+            acc, g = 0.0, 1.0
+            end = t
+            for k in range(t, min(t + n, T)):
+                acc += g * rewards[k]
+                g *= gamma
+                end = k
+                if terms[k]:
+                    break
+            new_r[t] = acc
+            new_disc[t] = g
+            new_next[t] = next_obs[end]
+            new_term[t] = terms[end]
+        ep[SampleBatch.REWARDS] = new_r
+        ep[SampleBatch.NEXT_OBS] = new_next
+        ep[SampleBatch.TERMINATEDS] = new_term
+        ep["nstep_discount"] = new_disc
+        episodes.append(ep)
+    return SampleBatch.concat_samples(episodes)
 
 
 class DQN(Algorithm):
@@ -204,7 +247,9 @@ class DQN(Algorithm):
     def training_step(self) -> dict:
         cfg = self.algo_config
         rollout = self.env_runner_group.sample(cfg.get_rollout_fragment_length())
-        self.replay_buffer.add(rollout)
+        self.replay_buffer.add(
+            n_step_transitions(rollout, cfg.n_step, cfg.gamma)
+        )
         self._env_steps_total += rollout.count
         self._steps_since_target_sync += rollout.count
 

@@ -72,7 +72,17 @@ class IMPALALearner(Learner):
             batch[SampleBatch.TERMINATEDS], batch[SampleBatch.TRUNCATEDS]
         ).astype(jnp.float32)
         discounts = tm(cfg.gamma * (1.0 - dones))
-        rewards = tm(batch[SampleBatch.REWARDS])
+        # Truncations are not true terminals: fold the runner's bootstrap
+        # value V(final_observation) (VALUES_BOOTSTRAPPED, stale by one
+        # weight version) into the reward at the truncated step, so cutting
+        # the recursion there (discount 0) still credits the episode tail.
+        rewards_flat = batch[SampleBatch.REWARDS]
+        if SampleBatch.VALUES_BOOTSTRAPPED in batch:
+            trunc = batch[SampleBatch.TRUNCATEDS].astype(jnp.float32)
+            rewards_flat = rewards_flat + cfg.gamma * trunc * batch[
+                SampleBatch.VALUES_BOOTSTRAPPED
+            ]
+        rewards = tm(rewards_flat)
         values_tm = tm(values)
         # Bootstrap from V(next_obs of each fragment's last step).
         next_obs_tm = tm(batch[SampleBatch.NEXT_OBS])

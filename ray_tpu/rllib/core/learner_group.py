@@ -101,17 +101,24 @@ class LearnerGroup:
         if self.is_local:
             return self._local.update(batch)
         # Shard the batch across learners on slice_unit boundaries;
-        # grad-average; apply everywhere.
+        # grad-average; apply everywhere. Units distribute round-robin so no
+        # learner ever receives an empty shard (empty batches mean NaN
+        # means that would poison the gradient average).
         n = len(self._workers)
         unit = self._slice_unit
         num_units = batch.count // unit
-        units_per = max(1, num_units // n)
-        shards = []
-        for i in range(n):
-            start = i * units_per * unit
-            end = (i + 1) * units_per * unit if i < n - 1 else num_units * unit
-            if start < end:
+        if num_units == 0:
+            shards = [batch]  # smaller than one unit: single learner
+        else:
+            shards = []
+            start = 0
+            for i in range(min(n, num_units)):
+                take = num_units // n + (1 if i < num_units % n else 0)
+                end = start + take * unit
+                # Partial-unit tail rows (count % unit) are dropped — they
+                # would break the fragment reshape in order-dependent losses.
                 shards.append(batch.slice(start, end))
+                start = end
         workers = self._workers[: len(shards)]
         results = ray_tpu.get(
             [w.compute_gradients.remote(s) for w, s in zip(workers, shards)]

@@ -1,0 +1,151 @@
+"""Native shared-memory store tests (src/store/tpu_store.cc).
+
+Mirrors the reference's plasma test strategy (object_store_test.cc,
+object_lifecycle_manager tests + python tests/test_object_store.py):
+lifecycle, pinning, eviction, cross-process visibility."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.native_store import (
+    NativeStore,
+    NativeStoreFullError,
+    native_store_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_store_available(), reason="native store lib unavailable"
+)
+
+
+@pytest.fixture
+def store():
+    name = f"/tps_test_{os.getpid()}"
+    s = NativeStore(name, capacity=8 << 20)
+    yield s
+    s.destroy()
+
+
+def test_put_get_roundtrip(store):
+    data = b"x" * 10_000
+    store.put_raw(b"id-1", data)
+    view = store.get_raw(b"id-1")
+    assert bytes(view) == data
+    store.release(b"id-1")
+
+
+def test_object_pickle5_zero_copy(store):
+    arr = np.arange(50_000, dtype=np.float64)
+    store.put_object(b"obj", {"a": arr, "b": "meta"})
+    found, out = store.get_object(b"obj")
+    assert found and out["b"] == "meta"
+    np.testing.assert_array_equal(out["a"], arr)
+    assert not out["a"].flags["OWNDATA"]  # view onto shm
+
+
+def test_contains_delete_pin(store):
+    store.put_raw(b"k", b"payload")
+    assert store.contains(b"k")
+    assert store.pin(b"k")
+    assert not store.delete(b"k")  # pinned -> refused
+    store.release(b"k")
+    assert store.delete(b"k")
+    assert not store.contains(b"k")
+
+
+def test_lru_eviction_under_pressure(store):
+    # 8MB capacity; write 20 x 1MB unpinned objects -> early ones evicted.
+    for i in range(20):
+        store.put_object(f"e{i}".encode(), np.ones(1 << 17, dtype=np.float64))
+    assert store.num_objects() < 20
+    assert store.contains(b"e19")  # most recent survives
+    assert not store.contains(b"e0")
+
+
+def test_pinned_objects_never_evicted(store):
+    store.put_object(b"pinned", np.ones(1 << 17, dtype=np.float64))
+    assert store.pin(b"pinned")
+    for i in range(20):
+        store.put_object(f"f{i}".encode(), np.ones(1 << 17, dtype=np.float64))
+    assert store.contains(b"pinned")
+
+
+def test_store_full_when_all_pinned(store):
+    store.put_object(b"big", np.ones(7 << 17, dtype=np.float64))  # ~7MB
+    store.pin(b"big")
+    with pytest.raises(NativeStoreFullError):
+        store.put_object(b"big2", np.ones(7 << 17, dtype=np.float64))
+
+
+def test_deferred_delete_until_views_die(store):
+    arr = np.arange(10_000, dtype=np.float32)
+    store.put_object(b"d", arr)
+    found, out = store.get_object(b"d")  # tracked view pins it
+    store.unpin_and_delete(b"d")
+    # Reader view still alive -> payload still readable.
+    np.testing.assert_array_equal(out, arr)
+    del out, found
+    import gc
+
+    gc.collect()
+    assert not store.contains(b"d")
+
+
+def _child_read(name: str, q) -> None:
+    try:
+        s = NativeStore(name, capacity=1)  # opens existing; capacity ignored
+        found, value = s.get_object(b"xproc")
+        q.put(("ok", float(np.asarray(value).sum())) if found else ("missing", None))
+        s.close()
+    except Exception as e:  # pragma: no cover
+        q.put(("error", repr(e)))
+    finally:
+        # Forked children inherit jax/pytest state whose atexit hooks crash;
+        # the queue already carries the result, so exit without running them.
+        q.close()
+        q.join_thread()
+        os._exit(0)
+
+
+def test_cross_process_read(store):
+    """A second process maps the same segment and reads the object —
+    the property the reference gets from plasma's unix-socket clients."""
+    arr = np.arange(1000, dtype=np.int64)
+    store.put_object(b"xproc", arr)
+    # fork (not spawn): spawn re-runs the pytest main module in the child,
+    # which fails under the test runner; fork proves the same property since
+    # the child still opens the segment by name, not via inheritance.
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_read, args=(store.name.decode(), q))
+    p.start()
+    status, total = q.get(timeout=30)
+    p.join(timeout=10)
+    assert status == "ok"
+    assert total == arr.sum()
+
+
+def test_runtime_integration_large_objects():
+    import ray_tpu
+
+    rt = ray_tpu.init(
+        num_cpus=2, _system_config={"native_store_threshold": 64 * 1024}
+    )
+    try:
+        if rt._native_store is None:
+            pytest.skip("native store unavailable in runtime")
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(500_000, dtype=np.float32)
+
+        arr = ray_tpu.get(produce.remote())
+        assert not arr.flags["OWNDATA"]
+        assert rt._native_store.num_objects() >= 1
+        small = ray_tpu.get(ray_tpu.put(123))  # small stays in python
+        assert small == 123
+    finally:
+        ray_tpu.shutdown()

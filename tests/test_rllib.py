@@ -446,3 +446,58 @@ def test_learner_group_slice_unit_alignment(ray_start_regular):
     result = algo.train()
     assert "policy_loss" in result
     algo.stop()
+
+
+def test_dqn_n_step_transitions():
+    from ray_tpu.rllib.algorithms.dqn.dqn import n_step_transitions
+
+    gamma = 0.9
+    batch = SampleBatch(
+        {
+            SampleBatch.REWARDS: np.array([1.0, 2.0, 3.0, 4.0], np.float32),
+            SampleBatch.TERMINATEDS: np.array([False, False, False, True]),
+            SampleBatch.NEXT_OBS: np.arange(4.0, dtype=np.float32)[:, None],
+            SampleBatch.EPS_ID: np.zeros(4, np.int64),
+        }
+    )
+    out = n_step_transitions(batch, n=3, gamma=gamma)
+    # t=0: r = 1 + .9*2 + .81*3 = 5.23, window ends at t=2 (not terminal)
+    np.testing.assert_allclose(out[SampleBatch.REWARDS][0], 5.23, rtol=1e-5)
+    assert out[SampleBatch.NEXT_OBS][0, 0] == 2.0
+    assert not out[SampleBatch.TERMINATEDS][0]
+    np.testing.assert_allclose(out["nstep_discount"][0], gamma**3, rtol=1e-5)
+    # t=2: window hits the terminal at t=3: r = 3 + .9*4 = 6.6, done=True
+    np.testing.assert_allclose(out[SampleBatch.REWARDS][2], 6.6, rtol=1e-5)
+    assert out[SampleBatch.TERMINATEDS][2]
+    # t=3: single terminal step
+    np.testing.assert_allclose(out[SampleBatch.REWARDS][3], 4.0)
+
+
+def test_learner_group_no_empty_shards(ray_start_regular):
+    """More learners than fragments: extra learners get no shard rather than
+    an empty batch (NaN-poisoned gradients)."""
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=1, rollout_fragment_length=10)
+        .training(train_batch_size=20)  # 2 fragments
+        .learners(num_learners=3, num_cpus_per_learner=0)
+    )
+    algo = cfg.build()
+    result = algo.train()
+    assert np.isfinite(result["total_loss"])
+    algo.stop()
+
+
+def test_store_free_then_delete_accounting(ray_start_regular):
+    """free() then refcount-driven delete() must not double-subtract from the
+    store's memory accounting."""
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    ref = ray_tpu.put(np.ones(1000))
+    rt.store.free([ref.id])
+    rt.store.delete([ref.id])
+    assert rt.store.used_bytes >= 0
