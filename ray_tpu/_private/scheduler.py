@@ -117,6 +117,14 @@ class Scheduler:
     def add_demand_listener(self, fn) -> None:
         self._demand_listeners.append(fn)
 
+    def remove_demand_listener(self, fn) -> None:
+        """Detach an autoscaler hook; with no listeners left the scheduler
+        reverts to failing infeasible tasks fast."""
+        try:
+            self._demand_listeners.remove(fn)
+        except ValueError:
+            pass
+
     def pending_demand(self) -> list[dict[str, float]]:
         with self._cond:
             return [p.request for p in self._queue]

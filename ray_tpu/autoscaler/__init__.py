@@ -1,0 +1,22 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.load_metrics import LoadMetrics, LoadSnapshot
+from ray_tpu.autoscaler.monitor import Monitor
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    NodeProvider,
+    TAG_NODE_TYPE,
+    TAG_SLICE_ID,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import ResourceDemandScheduler
+
+__all__ = [
+    "FakeNodeProvider",
+    "LoadMetrics",
+    "LoadSnapshot",
+    "Monitor",
+    "NodeProvider",
+    "ResourceDemandScheduler",
+    "StandardAutoscaler",
+    "TAG_NODE_TYPE",
+    "TAG_SLICE_ID",
+]

@@ -1,0 +1,93 @@
+"""NodeProvider — the cloud-side plugin surface of the autoscaler.
+
+Reference: autoscaler/node_provider.py (NodeProvider ABC) and the test-keystone
+FakeMultiNodeProvider (autoscaler/_private/fake_multi_node/node_provider.py:237)
+which simulates the whole loop in-process. Here the fake provider adds/removes
+logical nodes on the running in-process cluster, which is exactly how the
+reference's fake provider makes autoscaler + failure paths testable without
+cloud hardware (SURVEY.md §4).
+
+TPU twist: a node type may declare `hosts_per_slice > 1`; creating one "node"
+of that type launches the whole slice's hosts atomically (a TPU slice scales
+as a unit — you cannot add half an ICI domain).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract (create/terminate/list + tags)."""
+
+    def __init__(self, provider_config: Optional[dict] = None):
+        self.provider_config = provider_config or {}
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, type_config: dict, count: int = 1) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+
+TAG_NODE_TYPE = "ray-node-type"
+TAG_SLICE_ID = "tpu-slice-id"
+TAG_SLICE_HOST = "tpu-slice-host"
+
+
+class FakeNodeProvider(NodeProvider):
+    """Backs provider calls with logical nodes on the in-process runtime."""
+
+    def __init__(self, runtime, provider_config: Optional[dict] = None):
+        super().__init__(provider_config)
+        self.runtime = runtime
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}  # provider id -> {node_id, tags}
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def runtime_node_id(self, provider_id: str):
+        with self._lock:
+            return self._nodes[provider_id]["node_id"]
+
+    def create_node(self, node_type: str, type_config: dict, count: int = 1) -> List[str]:
+        created = []
+        resources = dict(type_config.get("resources", {}))
+        labels = dict(type_config.get("labels", {}))
+        hosts = int(type_config.get("hosts_per_slice", 1))
+        for _ in range(count):
+            slice_id = uuid.uuid4().hex[:8] if hosts > 1 else None
+            for host in range(hosts):
+                tags = {TAG_NODE_TYPE: node_type}
+                node_labels = dict(labels)
+                if slice_id:
+                    tags[TAG_SLICE_ID] = slice_id
+                    tags[TAG_SLICE_HOST] = str(host)
+                    node_labels["tpu-slice"] = slice_id
+                    node_labels["tpu-host"] = str(host)
+                node_id = self.runtime.add_node(resources, node_labels)
+                pid = f"fake-{uuid.uuid4().hex[:12]}"
+                with self._lock:
+                    self._nodes[pid] = {"node_id": node_id, "tags": tags}
+                created.append(pid)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is not None:
+            self.runtime.remove_node(info["node_id"])
