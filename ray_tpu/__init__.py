@@ -23,6 +23,7 @@ from ray_tpu.api import (
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu._private.object_ref import ObjectRef
@@ -53,5 +54,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
 ]

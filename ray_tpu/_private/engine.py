@@ -342,6 +342,7 @@ class ActorExecutor:
         async def run_one(spec: TaskSpec):
             async with sem:
                 self._set_context(spec)
+                self._record_running(spec)
                 try:
                     args, kwargs = self._resolve_args(spec)
                     method = getattr(self.instance, spec.method_name)
@@ -375,8 +376,19 @@ class ActorExecutor:
             self._loop.close()
             self._loop = None
 
+    def _record_running(self, spec: TaskSpec) -> None:
+        from ray_tpu._private.runtime import get_runtime
+
+        try:
+            get_runtime().task_events.record(
+                spec.task_id, "RUNNING", node_id=self.node.node.node_id
+            )
+        except Exception:
+            pass  # runtime tearing down
+
     def _execute_method(self, spec: TaskSpec) -> None:
         self._set_context(spec)
+        self._record_running(spec)
         try:
             args, kwargs = self._resolve_args(spec)
             method = getattr(self.instance, spec.method_name)

@@ -141,6 +141,14 @@ class ReferenceCounter:
         with self._lock:
             return len(self._refs)
 
+    def snapshot(self) -> dict:
+        """{object_id: total live references} for the state API."""
+        with self._lock:
+            return {
+                oid: ref.local_ref_count + ref.submitted_count
+                for oid, ref in self._refs.items()
+            }
+
     # -- internals -----------------------------------------------------------
 
     def _maybe_collect(self, object_id: ObjectID, ref: _Ref) -> None:

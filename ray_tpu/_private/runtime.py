@@ -176,6 +176,9 @@ class Runtime:
         self._actor_grants: dict[ActorID, tuple[NodeID, dict[str, float]]] = {}
         self._task_records: dict[TaskID, _TaskRecord] = {}
         self._streams: dict[TaskID, Any] = {}
+        from ray_tpu._private.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer()
         self._background = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu-bg"
         )
@@ -413,9 +416,21 @@ class Runtime:
         total = result.value if isinstance(result.value, int) else 0
         stream.finish(total)
 
+    def _record_pending(self, spec: TaskSpec, request: Optional[dict] = None) -> None:
+        self.task_events.record(
+            spec.task_id,
+            "PENDING_ARGS_AVAIL",
+            name=spec.name,
+            kind=spec.kind.name,
+            job_id=spec.job_id,
+            actor_id=spec.actor_id,
+            required_resources=request,
+        )
+
     def _submit_when_ready(self, spec: TaskSpec, request: dict[str, float]) -> None:
         """Hold args alive for this attempt, then queue once deps are sealed
         (LocalDependencyResolver, transport/dependency_resolver.h)."""
+        self._record_pending(spec, request)
         deps = self._dep_ids(spec)
         self.refcount.update_submitted_task_references(deps)
         if not deps:
@@ -538,6 +553,7 @@ class Runtime:
         submission order, with the chain head blocking on its argument deps —
         the caller-side sequential submit queue
         (transport/sequential_actor_submit_queue.h)."""
+        self._record_pending(spec)
         deps = self._dep_ids(spec)
         self.refcount.update_submitted_task_references(deps)
         entry = {"spec": spec, "ready": not deps}
@@ -658,6 +674,7 @@ class Runtime:
     # ------------------------------------------------------------- dispatch
 
     def _dispatch(self, spec: TaskSpec, node: NodeState, grant: dict[str, float]):
+        self.task_events.record(spec.task_id, "RUNNING", node_id=node.node_id)
         with self._lock:
             engine = self.engines.get(node.node_id)
             record = self._task_records.get(spec.task_id)
@@ -800,6 +817,16 @@ class Runtime:
                 record.finalized = True
                 if spec.kind != TaskKind.ACTOR_CREATION:
                     self._task_records.pop(spec.task_id, None)
+        if result.cancelled or result.exc is not None:
+            exc = result.exc
+            self.task_events.record(
+                spec.task_id,
+                "FAILED",
+                error_type=type(exc).__name__ if exc is not None else "Cancelled",
+                error_message=str(exc) if exc is not None else "",
+            )
+        else:
+            self.task_events.record(spec.task_id, "FINISHED")
         try:
             if not already_decrefed:
                 self.refcount.update_finished_task_references(self._dep_ids(spec))

@@ -230,3 +230,18 @@ def available_resources() -> dict[str, float]:
         for name, amount in node.available.items():
             totals[name] = totals.get(name, 0.0) + amount
     return totals
+
+
+def timeline(filename: Optional[str] = None):
+    """Chrome-trace timeline of task executions (reference:
+    ray.timeline, _private/state.py:831 backed by GCS profile events; here
+    backed by the runtime's task-event buffer). Returns the trace records,
+    and writes them as JSON when `filename` is given — load in
+    chrome://tracing or Perfetto."""
+    events = get_runtime().task_events.chrome_trace()
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,199 @@
+"""Application metrics: Counter / Gauge / Histogram + Prometheus exposition.
+
+Reference: python/ray/util/metrics.py (user-facing Cython-backed metric API)
+and _private/metrics_agent.py + prometheus_exporter.py (per-node agent
+exporting to Prometheus). In-process, metrics write to one registry and
+`prometheus_text()` renders the standard text exposition format that the
+reference's agent would serve on /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+def _tag_key(tags: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name or not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"Invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"Metric {name!r} already registered as {existing.kind}"
+                )
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self.tag_keys) - set(self._default_tags)
+            if unknown and self.tag_keys:
+                raise ValueError(f"Unknown tag keys {unknown} for {self.name}")
+            merged.update(tags)
+        return _tag_key(merged)
+
+    def _series(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        if value < 0:
+            raise ValueError("Counters only increase")
+        key = self._merged(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _series(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[dict] = None) -> None:
+        with self._lock:
+            self._values[self._merged(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        key = self._merged(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        self.inc(-value, tags)
+
+    def _series(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        self._buckets: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._counts: Dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[dict] = None) -> None:
+        key = self._merged(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1)
+            )
+            # bisect_left: Prometheus `le` is inclusive, so a value equal to
+            # a boundary belongs in that boundary's bucket.
+            buckets[bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def _series(self) -> dict:
+        with self._lock:
+            return {
+                key: {
+                    "buckets": list(self._buckets[key]),
+                    "sum": self._sums[key],
+                    "count": self._counts[key],
+                }
+                for key in self._buckets
+            }
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus exposition label escaping: backslash, quote, newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_tags(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Render every registered metric in Prometheus text exposition format."""
+    lines: List[str] = []
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        series = m._series()
+        if isinstance(m, Histogram):
+            for key, data in series.items():
+                cumulative = 0
+                for bound, n in zip(m.boundaries, data["buckets"]):
+                    cumulative += n
+                    tag = dict(key)
+                    tag["le"] = repr(bound)
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_tags(_tag_key(tag))} {cumulative}"
+                    )
+                tag = dict(key)
+                tag["le"] = "+Inf"
+                lines.append(
+                    f"{m.name}_bucket{_fmt_tags(_tag_key(tag))} {data['count']}"
+                )
+                lines.append(f"{m.name}_sum{_fmt_tags(key)} {data['sum']}")
+                lines.append(f"{m.name}_count{_fmt_tags(key)} {data['count']}")
+        else:
+            for key, value in series.items():
+                lines.append(f"{m.name}{_fmt_tags(key)} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry() -> None:
+    """Test helper."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

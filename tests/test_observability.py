@@ -1,0 +1,235 @@
+"""State API / task events / timeline / metrics / job submission tests.
+
+Reference strategies: tests/test_state_api.py, test_metrics_agent.py,
+dashboard/modules/job/tests (SURVEY.md §4)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+from ray_tpu.util.state import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    summarize_tasks,
+)
+
+
+# -- task events / state API ----------------------------------------------
+
+
+def test_list_tasks_lifecycle(ray_start_regular):
+    @ray_tpu.remote
+    def fine():
+        return 1
+
+    @ray_tpu.remote
+    def broken():
+        raise ValueError("boom")
+
+    ray_tpu.get(fine.remote())
+    with pytest.raises(Exception):
+        ray_tpu.get(broken.remote())
+
+    rows = list_tasks()
+    # Names are qualnames (nested test functions get a <locals> prefix).
+    by_name = {r["name"].split(".")[-1]: r for r in rows}
+    assert by_name["fine"]["state"] == "FINISHED"
+    assert by_name["broken"]["state"] == "FAILED"
+    assert by_name["broken"]["error_type"]
+    finished = list_tasks(filters=[("state", "=", "FINISHED")])
+    assert all(r["state"] == "FINISHED" for r in finished)
+
+
+def test_list_actors_and_nodes(ray_start_regular):
+    @ray_tpu.remote
+    class Thing:
+        def poke(self):
+            return "ok"
+
+    handle = Thing.options(name="thing-1").remote()
+    ray_tpu.get(handle.poke.remote())
+    actors = list_actors()
+    assert any(a["class_name"] == "Thing" and a["state"] == "ALIVE" for a in actors)
+    nodes = list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["state"] == "ALIVE"
+
+
+def test_list_objects_and_pgs(ray_start_regular):
+    ref = ray_tpu.put([1, 2, 3])
+    objects = list_objects()
+    assert any(o["object_id"] == ref.id.hex() for o in objects)
+
+    from ray_tpu.util import placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    pgs = list_placement_groups()
+    assert any(p["state"] == "CREATED" for p in pgs)
+
+
+def test_summarize_and_timeline(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def step():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([step.remote() for _ in range(3)])
+    summary = summarize_tasks()
+    assert any(
+        k.endswith("step:FINISHED") and v == 3 for k, v in summary.items()
+    ), summary
+
+    out = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(out))
+    assert out.exists()
+    step_events = [e for e in events if e["name"].split(".")[-1] == "step"]
+    assert len(step_events) == 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in step_events)
+
+
+def test_actor_task_events(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def bump(self):
+            self.x += 1
+            return self.x
+
+    c = Counter.remote()
+    ray_tpu.get(c.bump.remote())
+    rows = list_tasks(filters=[("type", "=", "ACTOR_TASK")])
+    assert any(r["name"].endswith("bump") for r in rows)
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram():
+    metrics.clear_registry()
+    c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "a"})
+    c.inc(2, tags={"route": "a"})
+    c.inc(tags={"route": "b"})
+    g = metrics.Gauge("inflight", "in flight")
+    g.set(5)
+    g.dec()
+    h = metrics.Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = metrics.prometheus_text()
+    assert 'req_total{route="a"} 3.0' in text
+    assert 'req_total{route="b"} 1.0' in text
+    assert "inflight 4.0" in text
+    assert "latency_s_count 3" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+
+
+def test_counter_rejects_negative_and_bad_tags():
+    metrics.clear_registry()
+    c = metrics.Counter("x_total", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"nope": "v"})
+
+
+# -- job submission --------------------------------------------------------
+
+
+def test_job_submission_end_to_end(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job says hi')\"",
+        metadata={"owner": "test"},
+    )
+    status = client.wait_until_finish(job_id, timeout=60.0)
+    assert status == "SUCCEEDED"
+    assert "job says hi" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info.metadata == {"owner": "test"}
+    assert any(j.job_id == job_id for j in client.list_jobs())
+    assert client.delete_job(job_id)
+
+
+def test_job_failure_and_env_vars(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os,sys; print(os.environ['MY_FLAG']); sys.exit(3)\"",
+        runtime_env={"env_vars": {"MY_FLAG": "flag-value"}},
+    )
+    status = client.wait_until_finish(job_id, timeout=60.0)
+    assert status == "FAILED"
+    assert "exited with code 3" in client.get_job_info(job_id).message
+    assert "flag-value" in client.get_job_logs(job_id)
+
+
+def test_job_stop(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\""
+    )
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if client.get_job_status(job_id) == "RUNNING":
+            break
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, timeout=30.0) == "STOPPED"
+
+
+def test_histogram_boundary_inclusive():
+    """Prometheus `le` is inclusive: a boundary-valued observation counts in
+    that boundary's bucket (regression: bisect_right shifted it up)."""
+    metrics.clear_registry()
+    h = metrics.Histogram("bound_s", boundaries=[0.1, 1.0])
+    h.observe(0.1)
+    text = metrics.prometheus_text()
+    assert 'bound_s_bucket{le="0.1"} 1' in text
+
+
+def test_metrics_label_escaping():
+    metrics.clear_registry()
+    c = metrics.Counter("esc_total", tag_keys=("k",))
+    c.inc(tags={"k": 'say "hi"\nnow'})
+    text = metrics.prometheus_text()
+    assert 'k="say \\"hi\\"\\nnow"' in text
+
+
+def test_async_actor_tasks_in_timeline(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self):
+            return 7
+
+    a = AsyncActor.options(max_concurrency=2).remote()
+    assert ray_tpu.get(a.work.remote()) == 7
+    events = ray_tpu.timeline()
+    assert any(e["name"].endswith("work") for e in events)
+
+
+def test_task_event_buffer_keeps_live_tasks():
+    from ray_tpu._private.task_events import TaskEventBuffer
+
+    buf = TaskEventBuffer(max_events=3)
+    buf.record("live-1", "RUNNING", name="live")
+    for i in range(5):
+        buf.record(f"done-{i}", "FINISHED", name="done")
+    states = {ev.task_id: ev.state for ev in buf.list_events()}
+    assert "live-1" in states  # finished events evicted before the live one
