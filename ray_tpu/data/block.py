@@ -92,12 +92,17 @@ class BlockAccessor:
         return pd.DataFrame(self.to_numpy_dict())
 
     def to_arrow(self):
+        with _ARROW_BUILD_LOCK:
+            return self.to_arrow_locked()
+
+    def to_arrow_locked(self):
+        """Arrow conversion for callers already holding _ARROW_BUILD_LOCK
+        (the lock is not reentrant)."""
         import pyarrow as pa
 
-        with _ARROW_BUILD_LOCK:
-            return pa.Table.from_pydict(
-                {k: v for k, v in self.to_numpy_dict().items()}
-            )
+        return pa.Table.from_pydict(
+            {k: v for k, v in self.to_numpy_dict().items()}
+        )
 
     def take_columns(self, keys) -> Block:
         d = self.to_numpy_dict()
@@ -196,6 +201,9 @@ class ArrowBlockAccessor(BlockAccessor):
         }
 
     def to_arrow(self):
+        return self._block
+
+    def to_arrow_locked(self):
         return self._block
 
     def to_pandas(self):

@@ -1,11 +1,13 @@
 from ray_tpu.models.gpt import (
     GPT,
     GPTConfig,
+    collect_moe_losses,
     cross_entropy_loss,
     gpt2_125m,
     gpt2_350m,
     gpt2_760m,
 )
+from ray_tpu.models.moe import MoEConfig, MoEMlp
 from ray_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -18,6 +20,9 @@ from ray_tpu.models.resnet import (
 __all__ = [
     "GPT",
     "GPTConfig",
+    "MoEConfig",
+    "MoEMlp",
+    "collect_moe_losses",
     "ResNet",
     "ResNet18",
     "ResNet34",

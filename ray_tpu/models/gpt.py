@@ -37,6 +37,12 @@ class GPTConfig:
     # "flash" (pallas kernel), "reference", or "ring" (requires sp-sharded
     # inputs under shard_map with axis name `sp`).
     attention_impl: str = "flash"
+    # MoE: num_experts=0 keeps dense MLPs; otherwise every `moe_every`-th
+    # block swaps its MLP for a MoEMlp (experts shard on the ep mesh axis).
+    num_experts: int = 0
+    moe_every: int = 2
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -72,6 +78,7 @@ def _dense(features, logical_axes, dtype, name=None, use_bias=True):
 
 class Block(nn.Module):
     config: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -91,10 +98,28 @@ class Block(nn.Module):
         attn = _dense(cfg.embed_dim, ("heads", "embed"), cfg.dtype, name="attn_proj")(attn)
         x = x + attn
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
-        h = _dense(cfg.mlp_ratio * cfg.embed_dim, ("embed", "mlp"), cfg.dtype,
-                   name="mlp_in")(h)
-        h = nn.gelu(h)
-        h = _dense(cfg.embed_dim, ("mlp", "embed"), cfg.dtype, name="mlp_out")(h)
+        if self.use_moe:
+            from ray_tpu.models.moe import MoEConfig, MoEMlp
+
+            h, aux = MoEMlp(
+                embed_dim=cfg.embed_dim,
+                mlp_dim=cfg.mlp_ratio * cfg.embed_dim,
+                moe=MoEConfig(
+                    num_experts=cfg.num_experts,
+                    num_experts_per_tok=cfg.num_experts_per_tok,
+                    capacity_factor=cfg.moe_capacity_factor,
+                ),
+                dtype=cfg.dtype,
+                name="moe_mlp",
+            )(h)
+            # Collected by the train step via mutable=["intermediates"]
+            # (collect_moe_losses helper below).
+            self.sow("intermediates", "moe_aux", aux)
+        else:
+            h = _dense(cfg.mlp_ratio * cfg.embed_dim, ("embed", "mlp"), cfg.dtype,
+                       name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = _dense(cfg.embed_dim, ("mlp", "embed"), cfg.dtype, name="mlp_out")(h)
         return x + h
 
 
@@ -125,7 +150,12 @@ class GPT(nn.Module):
         )
         x = wte(tokens) + wpe(jnp.arange(s)[None, :])
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+            use_moe = bool(
+                cfg.num_experts and (i % cfg.moe_every == cfg.moe_every - 1)
+            )
+            x = Block(cfg, use_moe=use_moe, name=f"h_{i}")(
+                x, deterministic=deterministic
+            )
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Tied LM head: logits via the embedding matrix (f32 for the softmax).
         logits = wte.attend(x.astype(jnp.float32))
@@ -145,3 +175,22 @@ def logical_axis_rules(rules_table: dict) -> list[tuple[str, Any]]:
     """Convert a ray_tpu.parallel rules table into flax logical-axis rules
     (for nn.logical_to_mesh_sharding)."""
     return [(name, axis) for name, axis in rules_table.items()]
+
+
+def collect_moe_losses(intermediates: Any) -> jax.Array:
+    """Sum MoE aux losses sown by Blocks: run `model.apply(params, tokens,
+    mutable=["intermediates"])` and pass the returned collection here.
+    Only `moe_aux` entries are summed — other sown diagnostics must never
+    leak into the training objective."""
+
+    def collect(node: Any, total: jax.Array) -> jax.Array:
+        if isinstance(node, dict):
+            for key, sub in node.items():
+                if key == "moe_aux":
+                    for leaf in jax.tree_util.tree_leaves(sub):
+                        total = total + jnp.asarray(leaf, jnp.float32)
+                else:
+                    total = collect(sub, total)
+        return total
+
+    return collect(intermediates, jnp.zeros((), jnp.float32))

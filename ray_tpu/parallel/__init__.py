@@ -4,6 +4,7 @@ from ray_tpu.parallel.mesh import (
     SliceTopology,
     auto_mesh,
 )
+from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from ray_tpu.parallel.sharding import (
     DP_RULES,
     EP_RULES,
@@ -33,7 +34,9 @@ __all__ = [
     "batch_sharding",
     "infer_param_sharding",
     "named_sharding",
+    "pipeline_apply",
     "replicated",
     "spec_for",
+    "stack_stage_params",
     "tree_shardings",
 ]

@@ -9,6 +9,7 @@ the collectives themselves are emitted by XLA over ICI (SURVEY.md §2.5).
 Axis convention (orders matter: outermost→innermost = slowest→fastest varying,
 so axes that should ride ICI neighbors go last):
 
+    pp    — pipeline parallelism (microbatch p2p only; tolerates DCN)
     dp    — pure data parallel (replicated params)
     fsdp  — data parallel with sharded params/optimizer (ZeRO-3 analog)
     sp    — sequence/context parallelism (ring attention neighbors)
@@ -24,14 +25,19 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshSpec:
     """Declarative mesh shape. Axis size -1 means 'absorb remaining devices'
-    (at most one axis may be -1); absent axes are size 1."""
+    (at most one axis may be -1); absent axes are size 1.
 
+    pp is outermost: pipeline stages exchange only microbatch activations
+    (point-to-point), so they tolerate the slowest links — across slices the
+    pp axis rides DCN while the inner axes stay on ICI."""
+
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     ep: int = 1
@@ -63,7 +69,7 @@ class MeshSpec:
         return [name for name in AXIS_ORDER if getattr(self, name) > 1]
 
     def build(self, devices: Optional[Sequence] = None):
-        """Create the `jax.sharding.Mesh`. All five axes are always present
+        """Create the `jax.sharding.Mesh`. All six axes are always present
         (size-1 axes are free), so sharding rules can name any axis."""
         import jax
         from jax.sharding import Mesh

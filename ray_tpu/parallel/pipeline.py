@@ -1,0 +1,123 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the `pp` mesh axis.
+
+The reference has no in-tree pipeline parallelism (SURVEY.md §2.4: delegated
+to Alpa release tests only); this is designed fresh the TPU way: all stages
+run inside ONE jitted program under `shard_map` over `pp`, activations move
+between neighbor stages with `lax.ppermute` (XLA lowers to collective-permute
+over ICI/DCN), and the fill/drain schedule is a `lax.scan` — no host-side
+per-stage actors on the hot path, so XLA overlaps the permute with compute.
+
+Schedule (GPipe): with S stages and M microbatches, step t ∈ [0, M+S-1);
+stage s computes microbatch (t - s) when 0 ≤ t - s < M. Bubble fraction is
+(S-1)/(M+S-1) — callers pick M ≥ 4·S to amortize.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(stage_params: list) -> Any:
+    """Stack per-stage param pytrees on a new leading `pp` axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """Run x through S pipelined stages; differentiable end to end.
+
+    stage_fn(params_s, h) -> h' must keep the activation shape (classic
+    homogeneous-stage pipelining). `stacked_params` leaves have a leading
+    S axis (stack_stage_params) sharded over `pp`; `x` is [batch, ...] with
+    batch divisible by num_microbatches.
+    """
+    n_stages = mesh.shape["pp"]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked_params leading axis {leaf.shape[0]} != pp axis "
+                f"{n_stages}; shard_map would silently drop stages"
+            )
+    M = num_microbatches
+    batch = x.shape[0]
+    assert batch % M == 0, f"batch {batch} not divisible by microbatches {M}"
+    mb = batch // M
+    microbatches = x.reshape((M, mb) + x.shape[1:])
+
+    params_spec = jax.tree_util.tree_map(lambda _: P("pp"), stacked_params)
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(params, mbs):
+        # Each pp rank holds its stage's params with a leading axis of 1.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index("pp")
+        act_shape = (mb,) + mbs.shape[2:]
+
+        def step(carry, t):
+            recv, acc = carry
+            # Stage 0 reads microbatch t (clamped; masked past M).
+            feed = jnp.where(
+                t < M,
+                jax.lax.dynamic_index_in_dim(
+                    mbs, jnp.minimum(t, M - 1), axis=0, keepdims=False
+                ),
+                jnp.zeros(act_shape, mbs.dtype),
+            )
+            inp = jnp.where(stage == 0, feed, recv)
+            active = jnp.logical_and(t - stage >= 0, t - stage < M)
+            out = stage_fn(params, inp)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            # Last stage banks microbatch (t - (S-1)) into the accumulator.
+            out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            write = jnp.logical_and(stage == n_stages - 1, active)
+            acc = jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jnp.where(
+                    write,
+                    out,
+                    jax.lax.dynamic_index_in_dim(acc, out_idx, 0, keepdims=False),
+                ),
+                out_idx,
+                axis=0,
+            )
+            # Ship activations to the next stage (rank 0 receives zeros).
+            recv = (
+                jax.lax.ppermute(out, "pp", fwd_perm)
+                if n_stages > 1
+                else jnp.zeros_like(out)
+            )
+            return (recv, acc), None
+
+        init = (
+            jnp.zeros(act_shape, mbs.dtype),
+            jnp.zeros((M,) + act_shape, mbs.dtype),
+        )
+        (recv, acc), _ = jax.lax.scan(
+            step, init, jnp.arange(M + n_stages - 1)
+        )
+        # Only the last stage holds real outputs; psum broadcasts them so the
+        # result is replicated (out_specs P()); other ranks contribute zeros.
+        keep = jnp.where(stage == n_stages - 1, 1.0, 0.0).astype(acc.dtype)
+        return jax.lax.psum(acc * keep, "pp")
+
+    out = run(stacked_params, microbatches)
+    return out.reshape((batch,) + out.shape[2:])

@@ -31,7 +31,7 @@ def test_mesh_spec_resolve():
 
 def test_mesh_build_8_devices():
     mesh = MeshSpec(dp=2, fsdp=2, tp=2).build()
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
     assert mesh.devices.size == 8
 
 
@@ -115,3 +115,185 @@ def test_host_collectives(ray_start_regular):
         assert reduced == [10.0, 10.0, 10.0, 10.0]
         assert gathered == [0, 1, 2, 3]
         assert got == "cfg"
+
+
+# -- pipeline parallelism -------------------------------------------------
+
+
+def _affine_stages(n_stages, width=16, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(seed)
+    stages = []
+    for _ in range(n_stages):
+        key, k1, k2 = jax.random.split(key, 3)
+        stages.append(
+            {
+                "w": jax.random.normal(k1, (width, width)) * 0.3,
+                "b": jax.random.normal(k2, (width,)) * 0.1,
+            }
+        )
+    return stages
+
+
+def _stage_fn(p, h):
+    import jax.numpy as jnp
+
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def test_pipeline_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel import MeshSpec, pipeline_apply, stack_stage_params
+
+    mesh = MeshSpec(pp=4, dp=2).build()
+    stages = _affine_stages(4)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, 16))
+    out = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, num_microbatches=4)
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel import MeshSpec, pipeline_apply, stack_stage_params
+
+    mesh = MeshSpec(pp=4, dp=2).build()
+    stages = _affine_stages(4, seed=3)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 16))
+
+    def loss_pipe(stacked):
+        out = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, num_microbatches=2)
+        return jnp.sum(out**2)
+
+    def loss_seq(stacked):
+        h = x
+        for i in range(4):
+            h = _stage_fn(jax.tree_util.tree_map(lambda p: p[i], stacked), h)
+        return jnp.sum(h**2)
+
+    g1 = jax.grad(loss_pipe)(stacked)
+    g2 = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+# -- mixture of experts ---------------------------------------------------
+
+
+def test_moe_forward_and_aux_losses():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import MoEConfig, MoEMlp
+
+    mod = MoEMlp(
+        embed_dim=32,
+        mlp_dim=64,
+        moe=MoEConfig(num_experts=4, num_experts_per_tok=2, capacity_factor=2.0),
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+    params = mod.init(jax.random.PRNGKey(1), x)
+    out, aux = mod.apply(params, x)
+    assert out.shape == x.shape
+    assert float(aux["router_z_loss"]) >= 0
+    assert float(aux["load_balance_loss"]) > 0
+
+
+def test_moe_ep_sharded_matches_replicated():
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import MoEConfig, MoEMlp
+    from ray_tpu.models.gpt import logical_axis_rules
+    from ray_tpu.parallel import EP_RULES, MeshSpec
+
+    mod = MoEMlp(
+        embed_dim=16,
+        mlp_dim=32,
+        moe=MoEConfig(num_experts=4, num_experts_per_tok=1, capacity_factor=2.0),
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16))
+    params = mod.init(jax.random.PRNGKey(1), x)
+    out_ref, _ = mod.apply(params, x)
+
+    mesh = MeshSpec(ep=4, dp=2).build()
+    shardings = nn.logical_to_mesh_sharding(
+        nn.get_partition_spec(jax.eval_shape(lambda: mod.init(jax.random.PRNGKey(1), x))),
+        mesh,
+        logical_axis_rules(EP_RULES),
+    )
+    sharded = jax.device_put(nn.meta.unbox(params), shardings)
+    out_sharded, _ = jax.jit(mod.apply)(sharded, x)
+    np.testing.assert_allclose(
+        np.asarray(out_ref, np.float32),
+        np.asarray(out_sharded, np.float32),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_gpt_moe_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT, GPTConfig, collect_moe_losses, cross_entropy_loss
+
+    cfg = GPTConfig(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+        max_seq_len=16, dtype=jnp.float32, attention_impl="reference",
+        num_experts=4, moe_every=2,
+    )
+    model = GPT(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(p):
+        logits, state = model.apply(p, tokens, mutable=["intermediates"])
+        return cross_entropy_loss(logits[:, :-1], tokens[:, 1:]) + collect_moe_losses(
+            state["intermediates"]
+        )
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert float(loss) > 0
+    gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0  # router + experts all received gradients
+
+
+def test_pipeline_rejects_mismatched_stage_count():
+    import jax
+    import pytest
+
+    from ray_tpu.parallel import MeshSpec, pipeline_apply, stack_stage_params
+
+    mesh = MeshSpec(pp=4, dp=2).build()
+    stacked = stack_stage_params(_affine_stages(8))  # 8 stages on pp=4
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+    with pytest.raises(ValueError, match="pp axis"):
+        pipeline_apply(_stage_fn, stacked, x, mesh=mesh, num_microbatches=4)
+
+
+def test_collect_moe_losses_ignores_other_intermediates():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import collect_moe_losses
+
+    intermediates = {
+        "h_0": {"moe_aux": ({"z": jnp.float32(0.5)},), "attn_entropy": (jnp.float32(99.0),)},
+        "h_1": {"moe_aux": ({"z": jnp.float32(0.25)},)},
+    }
+    total = collect_moe_losses(intermediates)
+    np.testing.assert_allclose(float(total), 0.75)
