@@ -54,6 +54,24 @@ class TaskResult:
         self.cancelled = cancelled
 
 
+def _activate_runtime_env(spec: TaskSpec, fallback: Optional[dict] = None):
+    """Scoped runtime-env application for one execution (env_vars + staged
+    sys.path dirs). Actor tasks fall back to the actor's creation env."""
+    from contextlib import nullcontext
+
+    from ray_tpu._private.runtime import get_runtime
+
+    env_spec = spec.runtime_env or fallback
+    if not env_spec:
+        return nullcontext()
+    try:
+        manager = get_runtime().runtime_env_manager
+    except Exception:
+        return nullcontext()
+    ctx = manager.get_or_create(env_spec)
+    return manager.activate(ctx)
+
+
 def _run_callable(fn: Callable, args: tuple, kwargs: dict) -> TaskResult:
     try:
         value = fn(*args, **kwargs)
@@ -153,11 +171,21 @@ class NodeEngine:
             CONTEXT.put_counter = 0
             try:
                 args, kwargs = resolve_args(spec)
-            except BaseException as exc:  # dep was freed/lost
-                self._on_task_done(spec, self.node, grant, TaskResult(exc=exc))
+                # Env staging can fail (missing working_dir): must surface as
+                # the task's failure, never escape into the pool and hang the
+                # caller with the grant leaked.
+                env_cm = _activate_runtime_env(spec)
+            except BaseException as exc:  # dep was freed/lost, bad env
+                self._on_task_done(
+                    spec,
+                    self.node,
+                    grant,
+                    TaskResult(exc=exc, traceback_str=traceback.format_exc()),
+                )
                 return
-            result = _run_callable(spec.func, args, kwargs)
-            result = _maybe_consume_stream(spec, result)
+            with env_cm:
+                result = _run_callable(spec.func, args, kwargs)
+                result = _maybe_consume_stream(spec, result)
             self._on_task_done(spec, self.node, grant, result)
 
         self._pool.submit(run)
@@ -293,9 +321,10 @@ class ActorExecutor:
         self._set_context(self.creation_spec)
         try:
             args, kwargs = self._resolve_args(self.creation_spec)
-            result = _run_callable(
-                lambda *a, **k: self.creation_spec.func(*a, **k), args, kwargs
-            )
+            with _activate_runtime_env(self.creation_spec):
+                result = _run_callable(
+                    lambda *a, **k: self.creation_spec.func(*a, **k), args, kwargs
+                )
             if result.exc is None:
                 self.instance = result.value
                 result = TaskResult(value=None)
@@ -346,16 +375,20 @@ class ActorExecutor:
                 try:
                     args, kwargs = self._resolve_args(spec)
                     method = getattr(self.instance, spec.method_name)
-                    if inspect.isasyncgenfunction(method) and spec.streaming:
-                        result = await _consume_async_stream(
-                            spec, method(*args, **kwargs)
-                        )
-                    else:
-                        if inspect.iscoroutinefunction(method):
-                            value = await method(*args, **kwargs)
+                    env = _activate_runtime_env(
+                        spec, fallback=self.creation_spec.runtime_env
+                    )
+                    with env:
+                        if inspect.isasyncgenfunction(method) and spec.streaming:
+                            result = await _consume_async_stream(
+                                spec, method(*args, **kwargs)
+                            )
                         else:
-                            value = method(*args, **kwargs)
-                        result = _maybe_consume_stream(spec, TaskResult(value=value))
+                            if inspect.iscoroutinefunction(method):
+                                value = await method(*args, **kwargs)
+                            else:
+                                value = method(*args, **kwargs)
+                            result = _maybe_consume_stream(spec, TaskResult(value=value))
                 except BaseException as exc:  # noqa: BLE001
                     result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
                 self._on_task_done(spec, self.node.node, {}, result)
@@ -392,10 +425,13 @@ class ActorExecutor:
         try:
             args, kwargs = self._resolve_args(spec)
             method = getattr(self.instance, spec.method_name)
-            result = _run_callable(method, args, kwargs)
-            result = _maybe_consume_stream(
-                spec, result, should_abort=lambda: self.dead
-            )
+            with _activate_runtime_env(
+                spec, fallback=self.creation_spec.runtime_env
+            ):
+                result = _run_callable(method, args, kwargs)
+                result = _maybe_consume_stream(
+                    spec, result, should_abort=lambda: self.dead
+                )
         except BaseException as exc:  # noqa: BLE001
             result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
         with self._lock:

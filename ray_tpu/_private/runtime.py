@@ -179,6 +179,9 @@ class Runtime:
         from ray_tpu._private.task_events import TaskEventBuffer
 
         self.task_events = TaskEventBuffer()
+        from ray_tpu._private.runtime_env import RuntimeEnvManager
+
+        self.runtime_env_manager = RuntimeEnvManager()
         self._background = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ray_tpu-bg"
         )
@@ -319,7 +322,11 @@ class Runtime:
         scheduling_strategy: Any,
         max_retries: int,
         retry_exceptions: Any,
+        runtime_env: Optional[dict] = None,
     ) -> list[ObjectRef]:
+        from ray_tpu._private.runtime_env import validate_runtime_env
+
+        runtime_env = validate_runtime_env(runtime_env)
         streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=self._new_task_id(),
@@ -337,6 +344,7 @@ class Runtime:
             # un-yielded (reference dedups by item index; out of scope here).
             max_retries=0 if streaming else max_retries,
             retry_exceptions=retry_exceptions,
+            runtime_env=runtime_env,
             parent_task_id=self.current_task_id(),
         )
         spec.compute_return_ids()
@@ -465,7 +473,11 @@ class Runtime:
         max_task_retries: int,
         max_concurrency: int,
         detached: bool,
+        runtime_env: Optional[dict] = None,
     ) -> tuple[ActorID, ObjectRef]:
+        from ray_tpu._private.runtime_env import validate_runtime_env
+
+        runtime_env = validate_runtime_env(runtime_env)
         actor_id = ActorID.of(self.job_id)
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
@@ -482,6 +494,7 @@ class Runtime:
             max_restarts=max_restarts,
             max_task_retries=max_task_retries,
             max_concurrency=max_concurrency,
+            runtime_env=runtime_env,
             parent_task_id=self.current_task_id(),
         )
         spec.compute_return_ids()
@@ -903,6 +916,10 @@ class Runtime:
             except Exception:
                 pass
             self._native_store = None
+        try:
+            self.runtime_env_manager.cleanup()
+        except Exception:
+            pass
         _RUNTIME = None
 
 

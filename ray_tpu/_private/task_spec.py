@@ -42,6 +42,7 @@ class TaskSpec:
     max_concurrency: int = 1
     max_restarts: int = 0
     max_task_retries: int = 0
+    runtime_env: Optional[dict] = None
     concurrency_groups: dict[str, int] = field(default_factory=dict)
     # Filled at submission:
     return_ids: list[ObjectID] = field(default_factory=list)

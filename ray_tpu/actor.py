@@ -132,6 +132,7 @@ class ActorClass:
                 max_task_retries=opts.get("max_task_retries", 0),
                 max_concurrency=opts.get("max_concurrency", 1),
                 detached=opts.get("lifetime") == "detached",
+                runtime_env=opts.get("runtime_env"),
             )
         except ValueError:
             # Name race: another creator won between our existence check and

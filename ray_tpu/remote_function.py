@@ -41,6 +41,7 @@ class RemoteFunction:
             scheduling_strategy=opts.get("scheduling_strategy"),
             max_retries=opts.get("max_retries", option_utils.DEFAULT_MAX_RETRIES),
             retry_exceptions=opts.get("retry_exceptions", False),
+            runtime_env=opts.get("runtime_env"),
         )
         if num_returns == 0:
             return None

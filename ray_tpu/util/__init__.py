@@ -4,6 +4,7 @@ from ray_tpu.util.placement_group import (
     placement_group,
     remove_placement_group,
 )
+from ray_tpu.util.check_serialize import inspect_serializability
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -14,6 +15,7 @@ __all__ = [
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "get_current_placement_group",
+    "inspect_serializability",
     "placement_group",
     "remove_placement_group",
 ]

@@ -1,0 +1,165 @@
+"""Runtime env + serializability-check tests (reference:
+tests/test_runtime_env*.py strategy, A.8)."""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import (
+    RuntimeEnvManager,
+    validate_runtime_env,
+)
+
+
+def test_validation():
+    assert validate_runtime_env(None) is None
+    assert validate_runtime_env({}) is None
+    ok = validate_runtime_env({"env_vars": {"A": "1"}})
+    assert ok == {"env_vars": {"A": "1"}}
+    with pytest.raises(ValueError, match="sealed"):
+        validate_runtime_env({"pip": ["requests"]})
+    with pytest.raises(ValueError, match="Unknown"):
+        validate_runtime_env({"bogus": 1})
+    with pytest.raises(TypeError):
+        validate_runtime_env({"env_vars": {"A": 1}})
+
+
+def test_task_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_FLAG": "task-value"}})
+    def read_flag():
+        return os.environ.get("RTENV_FLAG")
+
+    assert ray_tpu.get(read_flag.remote()) == "task-value"
+    # Restored after execution.
+    assert "RTENV_FLAG" not in os.environ
+
+
+def test_actor_env_vars_inherited_by_methods(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_ACTOR": "actor-value"}})
+    class EnvActor:
+        def __init__(self):
+            self.ctor_value = os.environ.get("RTENV_ACTOR")
+
+        def read(self):
+            return self.ctor_value, os.environ.get("RTENV_ACTOR")
+
+    actor = EnvActor.remote()
+    ctor, method = ray_tpu.get(actor.read.remote())
+    assert ctor == "actor-value"
+    assert method == "actor-value"
+
+
+def test_py_modules_importable(ray_start_regular, tmp_path):
+    mod_dir = tmp_path / "my_rtenv_mod"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text("MAGIC = 'from-py-module'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import my_rtenv_mod
+
+        return my_rtenv_mod.MAGIC
+
+    assert ray_tpu.get(use_module.remote()) == "from-py-module"
+    sys.modules.pop("my_rtenv_mod", None)
+
+
+def test_working_dir_on_sys_path(ray_start_regular, tmp_path):
+    (tmp_path / "wd_helper.py").write_text("VALUE = 41 + 1\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def use_helper():
+        import wd_helper
+
+        return wd_helper.VALUE
+
+    assert ray_tpu.get(use_helper.remote()) == 42
+    sys.modules.pop("wd_helper", None)
+
+
+def test_env_cache_reuses_staging(tmp_path):
+    manager = RuntimeEnvManager(cache_root=str(tmp_path / "cache"))
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "f.py").write_text("x = 1\n")
+    spec = {"working_dir": str(tmp_path / "src")}
+    ctx1 = manager.get_or_create(spec)
+    ctx2 = manager.get_or_create(dict(spec))
+    assert ctx1 is ctx2  # content-hash cache hit
+    manager.cleanup()
+
+
+def test_bad_runtime_env_fails_at_submission(ray_start_regular):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    with pytest.raises(ValueError):
+        noop.options(runtime_env={"conda": "env"}).remote()
+
+
+# -- check_serialize ------------------------------------------------------
+
+
+def test_inspect_serializability_finds_culprit():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    lock = threading.Lock()
+
+    def closure_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(closure_over_lock)
+    assert not ok
+    assert any(f.name == "lock" for f in failures)
+
+    ok, failures = inspect_serializability(lambda: 1)
+    assert ok and not failures
+
+
+def test_missing_working_dir_fails_not_hangs(ray_start_regular):
+    """Env staging errors surface as task failures (regression: the error
+    escaped into the thread pool and the caller hung forever)."""
+
+    @ray_tpu.remote(runtime_env={"working_dir": "/no/such/dir/at/all"})
+    def doomed():
+        return 1
+
+    with pytest.raises(Exception, match="working_dir"):
+        ray_tpu.get(doomed.remote(), timeout=15.0)
+
+
+def test_overlapping_activations_refcounted(ray_start_regular):
+    """Concurrent tasks sharing an env keep it active until the last exits."""
+    import time
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"SHARED_ENV": "on"}})
+    def slow_read(delay):
+        time.sleep(delay)
+        return os.environ.get("SHARED_ENV")
+
+    refs = [slow_read.remote(0.05), slow_read.remote(0.2)]
+    assert ray_tpu.get(refs, timeout=15.0) == ["on", "on"]
+    assert "SHARED_ENV" not in os.environ
+
+
+def test_nested_parent_attribution():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    class Client:
+        def __init__(self):
+            self._sock = threading.Lock()
+
+    class Holder:
+        def __init__(self):
+            self.client = Client()
+
+    ok, failures = inspect_serializability(Holder(), name="holder")
+    assert not ok
+    culprit = next(f for f in failures if f.name == "_sock")
+    assert culprit.parent == "client"
