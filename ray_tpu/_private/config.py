@@ -51,6 +51,11 @@ class Config:
     # least this large go to shm; 0 disables. Requires the C++ lib to build.
     native_store_threshold: int = 512 * 1024
     native_store_enabled: bool = True
+    # Object spilling: when the store is over budget and every remaining
+    # object is still referenced, primary copies move to disk (reference:
+    # raylet local_object_manager + external_storage.py).
+    object_spilling_enabled: bool = True
+    object_spill_directory: str = ""
     # Worker pool
     prestart_workers: bool = True
     idle_worker_killing_time_s: float = 60.0

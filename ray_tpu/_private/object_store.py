@@ -55,6 +55,7 @@ class _Entry:
         "last_access",
         "callbacks",
         "in_native",
+        "spilled_uri",
     )
 
     def __init__(self):
@@ -66,6 +67,7 @@ class _Entry:
         self.last_access = 0.0
         self.callbacks: list[Callable[[], None]] = []
         self.in_native = False
+        self.spilled_uri: str | None = None
 
 
 class InProcessStore:
@@ -81,6 +83,7 @@ class InProcessStore:
         memory_budget: int | None = None,
         native=None,
         native_threshold: int = 0,
+        spill_storage=None,
     ):
         self._lock = threading.Lock()
         self._entries: dict[ObjectID, _Entry] = {}
@@ -88,6 +91,7 @@ class InProcessStore:
         self._used = 0
         self._native = native
         self._native_threshold = native_threshold if native is not None else 0
+        self._spill = spill_storage
         # Objects the reference counter still holds references to may not be
         # evicted; the runtime installs this callback.
         self._pinned_check: Callable[[ObjectID], bool] = lambda oid: True
@@ -159,8 +163,20 @@ class InProcessStore:
             if entry.freed:
                 raise ObjectFreedError(object_id, f"Object {object_id} was freed")
             entry.last_access = time.monotonic()
-            if not entry.in_native:
+            spilled_uri = entry.spilled_uri
+            if spilled_uri is None and not entry.in_native:
                 return entry.value
+        if spilled_uri is not None:
+            # Restore from disk outside the lock. The value is returned
+            # without re-admitting it to the in-memory table (reads hit disk
+            # until memory pressure clears and a reseal happens naturally).
+            try:
+                return self._spill.restore(spilled_uri)
+            except FileNotFoundError:
+                # Raced with free()/delete() unlinking the spill file.
+                raise ObjectFreedError(
+                    object_id, f"Object {object_id} was freed"
+                ) from None
         # Deserialize outside the lock; arrays come back as zero-copy views
         # pinning the shm object until they are garbage collected.
         found, value = self._native.get_object(object_id)
@@ -214,27 +230,37 @@ class InProcessStore:
 
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
         natives = []
+        spilled = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.pop(oid, None)
                 if entry is not None and entry.sealed:
-                    self._used -= entry.size
+                    if entry.spilled_uri is not None:
+                        spilled.append(entry.spilled_uri)
+                    else:
+                        self._used -= entry.size
                     if entry.in_native:
                         natives.append(oid)
         for oid in natives:
             self._native.unpin_and_delete(oid)
+        for uri in spilled:
+            self._spill.delete(uri)
 
     def free(self, object_ids: Iterable[ObjectID]) -> None:
         """Mark freed: later `get`s raise ObjectFreedError (ray.internal.free)."""
         fired: list[Callable[[], None]] = []
         natives = []
+        spilled = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
                 if entry is not None:
-                    if entry.sealed:
+                    if entry.sealed and entry.spilled_uri is None:
                         self._used -= entry.size
-                        entry.size = 0  # a later delete() must not re-subtract
+                    entry.size = 0  # a later delete() must not re-subtract
+                    if entry.spilled_uri is not None:
+                        spilled.append(entry.spilled_uri)
+                        entry.spilled_uri = None
                     if entry.in_native:
                         natives.append(oid)
                         entry.in_native = False
@@ -245,6 +271,8 @@ class InProcessStore:
                     entry.callbacks = []
         for oid in natives:
             self._native.unpin_and_delete(oid)
+        for uri in spilled:
+            self._spill.delete(uri)
         for cb in fired:
             cb()
 
@@ -272,12 +300,18 @@ class InProcessStore:
         return entry
 
     def _evict_locked(self, need_bytes: int) -> None:
-        """LRU eviction of sealed, unpinned objects (plasma eviction_policy.h)."""
+        """LRU eviction of sealed, unpinned objects (plasma eviction_policy.h);
+        when everything left is referenced, primary copies spill to external
+        storage instead of failing (local_object_manager.h SpillObjects) —
+        their refs stay valid and `get` restores from disk."""
         candidates = sorted(
             (
                 (entry.last_access, oid, entry)
                 for oid, entry in self._entries.items()
-                if entry.sealed and not entry.freed and not self._pinned_check(oid)
+                if entry.sealed
+                and not entry.freed
+                and entry.spilled_uri is None  # spilled: no resident bytes
+                and not self._pinned_check(oid)
             ),
             key=lambda item: item[0],
         )
@@ -296,10 +330,33 @@ class InProcessStore:
             entry.freed = True
             entry.event.set()
             del self._entries[oid]
+        if reclaimed >= need_bytes:
+            return
+        if self._spill is not None:
+            spill_candidates = sorted(
+                (
+                    (entry.last_access, oid, entry)
+                    for oid, entry in self._entries.items()
+                    if entry.sealed
+                    and not entry.freed
+                    and not entry.in_native
+                    and entry.spilled_uri is None
+                ),
+                key=lambda item: item[0],
+            )
+            for _, oid, entry in spill_candidates:
+                if reclaimed >= need_bytes:
+                    break
+                # Spill IO under the lock: correctness over concurrency for
+                # the pressure path (the reference offloads to IO workers).
+                entry.spilled_uri = self._spill.spill(oid, entry.value)
+                entry.value = None
+                reclaimed += entry.size
+                self._used -= entry.size
         if reclaimed < need_bytes:
             raise OutOfMemoryError(
                 f"Object store over budget: need {need_bytes} more bytes but only "
-                f"{reclaimed} evictable"
+                f"{reclaimed} reclaimable (evictable + spillable)"
             )
 
     @property

@@ -155,10 +155,18 @@ class Runtime:
                     )
                 except Exception:
                     self._native_store = None
+        self._spill_storage = None
+        if self.config.object_spilling_enabled:
+            from ray_tpu._private.external_storage import FileSystemStorage
+
+            self._spill_storage = FileSystemStorage(
+                self.config.object_spill_directory or None
+            )
         self.store = InProcessStore(
             memory_budget=budget,
             native=self._native_store,
             native_threshold=self.config.native_store_threshold,
+            spill_storage=self._spill_storage,
         )
         self.refcount = ReferenceCounter(
             on_object_out_of_scope=lambda oid: self.store.delete([oid]),
@@ -920,6 +928,11 @@ class Runtime:
             self.runtime_env_manager.cleanup()
         except Exception:
             pass
+        if self._spill_storage is not None:
+            try:
+                self._spill_storage.destroy()
+            except Exception:
+                pass
         _RUNTIME = None
 
 

@@ -1,0 +1,8 @@
+from ray_tpu.rllib.algorithms.sac.sac import (
+    SAC,
+    SACConfig,
+    SACLearner,
+    SACModule,
+)
+
+__all__ = ["SAC", "SACConfig", "SACLearner", "SACModule"]

@@ -1,0 +1,279 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (SACConfig, sac_torch_policy losses: twin-Q
+TD targets with entropy, squashed-gaussian actor, auto-tuned alpha). The TPU
+re-design keeps the classic three-objective structure but runs it as ONE
+jitted loss: the actor term evaluates the critics through
+`jax.lax.stop_gradient` on the Q parameter subtree (and the alpha term
+stop-gradients the log-prob), so a single value_and_grad produces exactly the
+per-objective gradients the reference gets from three optimizers. Target twin
+critics live in the learner's extra state with polyak averaging after each
+update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class _MLP(nn.Module):
+    out_dim: int
+    hiddens: tuple = (256, 256)
+
+    @nn.compact
+    def __call__(self, x):
+        for i, w in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(w, name=f"fc_{i}")(x))
+        return nn.Dense(self.out_dim, name="out")(x)
+
+
+class SACNet(nn.Module):
+    """Policy + twin critics + log_alpha in one param tree, so subtree
+    stop-gradients can isolate each objective inside a single loss."""
+
+    action_dim: int
+    hiddens: tuple = (256, 256)
+
+    def setup(self):
+        self.pi = _MLP(2 * self.action_dim, self.hiddens)
+        self.q1 = _MLP(1, self.hiddens)
+        self.q2 = _MLP(1, self.hiddens)
+        self.log_alpha = self.param(
+            "log_alpha", nn.initializers.zeros, ()
+        )
+
+    def __call__(self, obs):
+        # Init path: touch every submodule so init() creates all params.
+        dummy_act = jnp.zeros(obs.shape[:-1] + (self.action_dim,), obs.dtype)
+        self.actor(obs)
+        self.critic(obs, dummy_act)
+        return self.log_alpha
+
+    def actor(self, obs):
+        out = self.pi(obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def critic(self, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return self.q1(x)[..., 0], self.q2(x)[..., 0]
+
+
+def _sample_squashed(mean, log_std, rng):
+    """Tanh-squashed gaussian sample + log-prob with the change-of-variables
+    correction (SAC appendix C)."""
+    std = jnp.exp(log_std)
+    raw = mean + std * jax.random.normal(rng, mean.shape)
+    action = jnp.tanh(raw)
+    logp = jnp.sum(
+        -0.5 * ((raw - mean) / std) ** 2 - log_std - 0.5 * jnp.log(2 * jnp.pi),
+        axis=-1,
+    )
+    logp = logp - jnp.sum(jnp.log(1 - action**2 + 1e-6), axis=-1)
+    return action, logp
+
+
+class SACModule(RLModule):
+    has_value_head = False
+
+    def __init__(self, observation_space, action_space, model_config=None,
+                 net=None, seed: int = 0):
+        assert isinstance(action_space, Box), "SAC needs a continuous space"
+        model_config = dict(model_config or {})
+        self.action_dim = int(np.prod(action_space.shape))
+        if net is None:
+            net = SACNet(
+                action_dim=self.action_dim,
+                hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
+            )
+        super().__init__(observation_space, action_space, model_config, net, seed)
+        # Action scaling tanh[-1,1] -> env bounds.
+        self._low = np.asarray(action_space.low, np.float32)
+        self._high = np.asarray(action_space.high, np.float32)
+
+    def _scale(self, a):
+        low, high = self._low, self._high
+        return low + (a + 1.0) * 0.5 * (high - low)
+
+    def forward_exploration(self, params, batch, rng) -> dict:
+        mean, log_std = self.net.apply(
+            params, batch[SampleBatch.OBS], method=SACNet.actor
+        )
+        action, logp = _sample_squashed(mean, log_std, rng)
+        return {
+            SampleBatch.ACTIONS: self._scale(action),
+            SampleBatch.ACTION_LOGP: logp,
+        }
+
+    def forward_inference(self, params, batch) -> dict:
+        mean, _ = self.net.apply(
+            params, batch[SampleBatch.OBS], method=SACNet.actor
+        )
+        return {SampleBatch.ACTIONS: self._scale(jnp.tanh(mean))}
+
+    def forward_train(self, params, batch) -> dict:
+        raise NotImplementedError("SACLearner drives the nets directly")
+
+    def unscale(self, actions):
+        low, high = self._low, self._high
+        return jnp.clip(
+            (actions - low) / (high - low + 1e-9) * 2.0 - 1.0, -0.999, 0.999
+        )
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SAC)
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005  # polyak coefficient for target critics
+        self.train_batch_size = 256
+        self.initial_alpha = 1.0
+        self.target_entropy: Optional[float] = None  # None -> -action_dim
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.replay_buffer_config = {"capacity": 100_000}
+        self.rollout_fragment_length = 1
+        self.training_intensity: Optional[float] = None
+        self._compute_gae_on_runner = False
+
+    def get_default_learner_class(self):
+        return SACLearner
+
+
+class SACLearner(Learner):
+    def build(self) -> None:
+        super().build()
+        module = self.module
+        self._target_entropy = (
+            self.config.target_entropy
+            if self.config.target_entropy is not None
+            else -float(module.action_dim)
+        )
+
+        tau = self.config.tau
+
+        @jax.jit
+        def polyak(target, online):
+            return jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target, online
+            )
+
+        self._polyak = polyak
+
+    def initial_extra_state(self):
+        # Target network = the critic subtrees of a param copy.
+        return {"target": jax.tree_util.tree_map(jnp.array, self.module.params)}
+
+    def compute_loss(self, params, batch, rng, extra=None):
+        cfg = self.config
+        net = self.module.net
+        module = self.module
+        obs = batch[SampleBatch.OBS]
+        next_obs = batch[SampleBatch.NEXT_OBS]
+        actions_env = batch[SampleBatch.ACTIONS]
+        actions = module.unscale(actions_env)
+        rewards = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+        rng_next, rng_pi = jax.random.split(rng)
+
+        log_alpha = net.apply(params, method=lambda m: m.log_alpha)
+        alpha = jnp.exp(log_alpha)
+
+        # Critic target: min target-Q of next action, entropy-regularized.
+        next_mean, next_log_std = net.apply(params, next_obs, method=SACNet.actor)
+        next_a, next_logp = _sample_squashed(next_mean, next_log_std, rng_next)
+        tq1, tq2 = net.apply(extra["target"], next_obs, next_a, method=SACNet.critic)
+        target_q = rewards + cfg.gamma * not_done * (
+            jnp.minimum(tq1, tq2) - jax.lax.stop_gradient(alpha) * next_logp
+        )
+        target_q = jax.lax.stop_gradient(target_q)
+        q1, q2 = net.apply(params, obs, actions, method=SACNet.critic)
+        critic_loss = jnp.mean((q1 - target_q) ** 2) + jnp.mean((q2 - target_q) ** 2)
+
+        # Actor: maximize min-Q of fresh actions, critics frozen via subtree
+        # stop-gradient (the single-loss equivalent of a separate actor opt).
+        frozen_q = jax.lax.stop_gradient(params)
+        mean, log_std = net.apply(params, obs, method=SACNet.actor)
+        a_pi, logp_pi = _sample_squashed(mean, log_std, rng_pi)
+        q1_pi, q2_pi = net.apply(frozen_q, obs, a_pi, method=SACNet.critic)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp_pi - jnp.minimum(q1_pi, q2_pi)
+        )
+
+        # Alpha: match the entropy target (log-prob stop-gradiented).
+        alpha_loss = -jnp.mean(
+            log_alpha * jax.lax.stop_gradient(logp_pi + self._target_entropy)
+        )
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "mean_q": jnp.mean(q1),
+        }
+
+    def after_update(self, batch) -> None:
+        self.extra_train_state = {
+            "target": self._polyak(
+                self.extra_train_state["target"], self.module.params
+            )
+        }
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.rl_module_spec is None:
+            from ray_tpu.rllib.env.env import make_env
+
+            probe = make_env(cfg.env, cfg.env_config)
+            cfg.rl_module_spec = RLModuleSpec(
+                module_class=SACModule,
+                observation_space=probe.observation_space,
+                action_space=probe.action_space,
+                model_config=dict(cfg.model),
+                seed=cfg.seed or 0,
+            )
+            probe.close()
+        super().setup(config)
+        self.replay_buffer = ReplayBuffer(
+            capacity=cfg.replay_buffer_config.get("capacity", 100_000),
+            seed=cfg.seed,
+        )
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        rollout = self.env_runner_group.sample(
+            max(1, cfg.rollout_fragment_length or 1)
+        )
+        self.replay_buffer.add(rollout)
+        self._env_steps_total += rollout.count
+        results = {"replay_buffer_size": len(self.replay_buffer)}
+        if self._env_steps_total >= cfg.num_steps_sampled_before_learning_starts:
+            intensity = cfg.training_intensity or (1.0 / rollout.count)
+            for _ in range(max(1, int(round(intensity * rollout.count)))):
+                train_batch = self.replay_buffer.sample(cfg.train_batch_size)
+                results.update(self.learner_group.update(train_batch))
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights(),
+                global_vars={"timestep": self._env_steps_total},
+            )
+        return results

@@ -1,0 +1,152 @@
+"""`ray-tpu` CLI — status / state listing / jobs / timeline / bench.
+
+Reference: python/ray/scripts/scripts.py (`ray status`, `ray list ...` via
+util/state/state_cli.py, `ray job submit` via the job CLI, `ray timeline`).
+The in-process runtime has no daemons to attach to, so every invocation
+bootstraps a local runtime (configurable with --num-cpus), runs the command,
+and shuts down — `job submit` still executes the entrypoint as a real
+subprocess with logs and status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _init(args) -> None:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=getattr(args, "num_cpus", None) or 8)
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    _init(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = ray_tpu.nodes()
+    print(f"Nodes: {len(nodes)}")
+    print("Resources:")
+    for name in sorted(total):
+        print(f"  {name}: {avail.get(name, 0.0):g}/{total[name]:g} available")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    _init(args)
+    fn = {
+        "tasks": state_api.list_tasks,
+        "actors": state_api.list_actors,
+        "nodes": state_api.list_nodes,
+        "objects": state_api.list_objects,
+        "placement-groups": state_api.list_placement_groups,
+    }[args.what]
+    rows = fn()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util.state import summarize_tasks
+
+    _init(args)
+    print(json.dumps(summarize_tasks(), indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+
+    _init(args)
+    events = ray_tpu.timeline(args.output)
+    print(f"Wrote {len(events)} trace events to {args.output}")
+    return 0
+
+
+def cmd_job(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    _init(args)
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        import shlex
+
+        parts = list(args.entrypoint)
+        if parts and parts[0] == "--":
+            parts = parts[1:]
+        # shlex.join keeps arguments with spaces (python -c "...") intact
+        # through the supervisor's shell.
+        entrypoint = shlex.join(parts)
+        env = {"env_vars": dict(kv.split("=", 1) for kv in args.env or [])}
+        job_id = client.submit_job(entrypoint=entrypoint, runtime_env=env)
+        print(f"Submitted {job_id}")
+        # The runtime (and its job table) lives only as long as this process,
+        # so the CLI always waits for the entrypoint (no --no-wait / list:
+        # those need a persistent cluster to attach to).
+        status = client.wait_until_finish(job_id, timeout=args.timeout)
+        print(f"Status: {status}")
+        sys.stdout.write(client.get_job_logs(job_id))
+        ray_tpu.shutdown()
+        return 0 if status == "SUCCEEDED" else 1
+    raise SystemExit(f"unknown job command {args.job_cmd!r}")
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.util.metrics import prometheus_text
+
+    _init(args)
+    sys.stdout.write(prometheus_text())
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="TPU-native distributed ML framework CLI"
+    )
+    parser.add_argument("--num-cpus", type=int, default=None)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster resources")
+
+    p_list = sub.add_parser("list", help="list cluster state")
+    p_list.add_argument(
+        "what",
+        choices=["tasks", "actors", "nodes", "objects", "placement-groups"],
+    )
+
+    sub.add_parser("summary", help="task summary by name:state")
+
+    p_tl = sub.add_parser("timeline", help="export chrome trace")
+    p_tl.add_argument("--output", default="timeline.json")
+
+    p_job = sub.add_parser("job", help="job submission")
+    job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
+    p_submit = job_sub.add_parser("submit")
+    p_submit.add_argument("--env", action="append", help="KEY=VALUE", default=None)
+    p_submit.add_argument("--timeout", type=float, default=3600.0)
+    p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+
+    sub.add_parser("metrics", help="prometheus exposition dump")
+
+    args = parser.parse_args(argv)
+    handler = {
+        "status": cmd_status,
+        "list": cmd_list,
+        "summary": cmd_summary,
+        "timeline": cmd_timeline,
+        "job": cmd_job,
+        "metrics": cmd_metrics,
+    }[args.cmd]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,12 @@ from ray_tpu.air.session import (
 from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxBackendConfig
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.sharded_checkpoint import (
+    restore_sharded,
+    restore_train_state,
+    save_sharded,
+    save_train_state,
+)
 from ray_tpu.train.jax_trainer import (
     JaxTrainer,
     prepare_batch,
@@ -52,4 +58,8 @@ __all__ = [
     "prepare_params",
     "prepare_step",
     "report",
+    "restore_sharded",
+    "restore_train_state",
+    "save_sharded",
+    "save_train_state",
 ]

@@ -3,6 +3,7 @@
 Reference strategies: tests/test_state_api.py, test_metrics_agent.py,
 dashboard/modules/job/tests (SURVEY.md §4)."""
 
+import os
 import sys
 import time
 
@@ -233,3 +234,39 @@ def test_task_event_buffer_keeps_live_tasks():
         buf.record(f"done-{i}", "FINISHED", name="done")
     states = {ev.task_id: ev.state for ev in buf.list_events()}
     assert "live-1" in states  # finished events evicted before the live one
+
+
+def test_cli_status_and_list(ray_start_regular, capsys):
+    # CLI handlers run against the already-initialized runtime (init is
+    # idempotent for the running session only through the module path; the
+    # handlers call init themselves, so drive them in-process).
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0
+    assert "Resources:" in out.stdout
+
+
+def test_cli_job_submit_roundtrip():
+    import subprocess
+
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "ray_tpu.scripts.cli", "job", "submit",
+            "--env", "CLI_FLAG=yes", "--",
+            sys.executable, "-c", "import os; print(os.environ['CLI_FLAG'])",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "SUCCEEDED" in out.stdout
+    assert "yes" in out.stdout

@@ -1,0 +1,106 @@
+"""Object spilling tests (reference: tests/test_object_spilling*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.external_storage import FileSystemStorage
+from ray_tpu._private.object_store import InProcessStore, OutOfMemoryError
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+
+
+_TASK = TaskID.for_job(JobID.from_int(1))
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.of(_TASK, i + 1)
+
+
+def test_spill_and_restore_roundtrip(tmp_path):
+    storage = FileSystemStorage(str(tmp_path))
+    store = InProcessStore(memory_budget=1_000_000, spill_storage=storage)
+    # Everything pinned (default pinned_check is always-pinned).
+    values = {}
+    for i in range(5):
+        arr = np.full(100_000, i, dtype=np.float32)  # 400KB each
+        values[i] = arr
+        store.seal(_oid(i), arr)
+    assert storage.stats()["num_spilled"] > 0
+    assert store.used_bytes <= 1_000_000
+    for i in range(5):
+        np.testing.assert_array_equal(store.get(_oid(i)), values[i])
+    storage.destroy()
+
+
+def test_oom_when_spilling_disabled():
+    store = InProcessStore(memory_budget=500_000, spill_storage=None)
+    store.seal(_oid(0), np.zeros(100_000, dtype=np.float32))
+    with pytest.raises(OutOfMemoryError):
+        store.seal(_oid(1), np.zeros(200_000, dtype=np.float32))
+
+
+def test_delete_removes_spill_files(tmp_path):
+    import os
+
+    storage = FileSystemStorage(str(tmp_path))
+    store = InProcessStore(memory_budget=500_000, spill_storage=storage)
+    for i in range(4):
+        store.seal(_oid(i), np.zeros(100_000, dtype=np.float32))
+    spilled_files = os.listdir(storage.directory)
+    assert spilled_files
+    store.delete([_oid(i) for i in range(4)])
+    assert not os.listdir(storage.directory)
+    storage.destroy()
+
+
+def test_end_to_end_spill_under_pressure():
+    rt = ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "object_store_memory": 3_000_000,
+            "native_store_enabled": False,
+        },
+    )
+    try:
+        refs = [
+            ray_tpu.put(np.full(250_000, i, dtype=np.float32)) for i in range(8)
+        ]
+        for i, ref in enumerate(refs):
+            assert ray_tpu.get(ref)[0] == i
+        stats = rt._spill_storage.stats()
+        assert stats["num_spilled"] > 0
+        assert rt.store.used_bytes <= 3_000_000
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_evicting_skips_spilled_entries(tmp_path):
+    """Spilled entries hold no resident bytes: mem eviction must not
+    double-subtract their size or orphan their files (regression)."""
+    storage = FileSystemStorage(str(tmp_path))
+    store = InProcessStore(memory_budget=1_000_000, spill_storage=storage)
+    store.set_pinned_check(lambda oid: True)  # everything pinned -> spills
+    for i in range(3):
+        store.seal(_oid(i), np.zeros(100_000, dtype=np.float32))
+    # Unpin everything; new pressure must evict resident entries only.
+    store.set_pinned_check(lambda oid: False)
+    for i in range(3, 7):
+        store.seal(_oid(i), np.zeros(100_000, dtype=np.float32))
+    assert store.used_bytes >= 0
+    # Spilled objects still restorable.
+    for i in range(3):
+        if store.contains(_oid(i)):
+            assert store.get(_oid(i)).nbytes == 400_000
+    storage.destroy()
+
+
+def test_user_spill_dir_not_wiped(tmp_path):
+    keep = tmp_path / "keep.txt"
+    keep.write_text("precious")
+    storage = FileSystemStorage(str(tmp_path))
+    uri = storage.spill(_oid(0), b"data")
+    storage.destroy()
+    assert keep.exists()  # user files survive
+    import os
+
+    assert not os.path.exists(uri)  # ours removed

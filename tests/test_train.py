@@ -189,3 +189,46 @@ def test_dataset_shard_list(ray_start_regular):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["n"] == 5
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import restore_sharded, save_sharded
+
+    mesh = MeshSpec(fsdp=8).build()
+    sh = NamedSharding(mesh, P("fsdp"))
+    state = {
+        "w": jax.device_put(jnp.arange(64.0).reshape(8, 8), sh),
+        "step": jnp.int32(7),
+    }
+    path = str(tmp_path / "ckpt")
+    save_sharded(path, state)
+    out = restore_sharded(
+        path, target=state, shardings={"w": sh, "step": NamedSharding(mesh, P())}
+    )
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(64.0).reshape(8, 8))
+    assert out["w"].sharding == sh
+    assert int(out["step"]) == 7
+
+
+def test_save_restore_train_state(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.train import restore_train_state, save_train_state
+
+    params = {"k": jnp.ones((4, 4))}
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    path = str(tmp_path / "train_state")
+    save_train_state(path, params, opt_state, step=11)
+    out = restore_train_state(path, params_target=params, opt_state_target=opt_state)
+    np.testing.assert_array_equal(np.asarray(out["params"]["k"]), np.ones((4, 4)))
+    assert int(out["step"]) == 11
+    assert "opt_state" in out
