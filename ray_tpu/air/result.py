@@ -15,6 +15,7 @@ class Result:
     error: Optional[BaseException] = None
     path: Optional[str] = None
     metrics_history: list[dict] = field(default_factory=list)
+    config: dict = field(default_factory=dict)
 
     @property
     def best_checkpoint(self) -> Optional[Checkpoint]:

@@ -1,0 +1,256 @@
+"""Multi-agent env runner — shared-policy sampling over MultiAgentEnv.
+
+Reference: rllib's multi-agent sampling (evaluation/env_runner_v2.py handling
+MultiAgentEnv + policy mapping). This runner implements the most common
+configuration — every agent steps the SAME module (parameter sharing) — by
+flattening agent transitions into single-agent rows: one forward pass batches
+all live agents each step, and each (episode, agent) pair gets its own eps_id
+so GAE and the learners treat agent trajectories independently. Any
+single-agent algorithm (PPO/IMPALA/DQN/SAC) then trains multi-agent envs
+unchanged — the reference needs its MultiAgentBatch plumbing for per-policy
+modules; that generalization rides MultiAgentRLModule later.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env import MultiAgentEnv, make_env
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+_PROBE_CACHE: dict = {}
+
+
+def is_multi_agent_env(env_spec, env_config) -> bool:
+    """Class-check without instantiation when the spec is a class; registered
+    names/callables are probed once and cached (envs may bind simulators or
+    sockets — don't pay that per worker-group construction)."""
+    if isinstance(env_spec, type):
+        return issubclass(env_spec, MultiAgentEnv)
+    key = None
+    if isinstance(env_spec, str):
+        key = (env_spec, repr(sorted((env_config or {}).items())))
+        if key in _PROBE_CACHE:
+            return _PROBE_CACHE[key]
+    probe = make_env(env_spec, env_config)
+    result = isinstance(probe, MultiAgentEnv)
+    probe.close()
+    if key is not None:
+        _PROBE_CACHE[key] = result
+    return result
+
+
+class MultiAgentEnvRunner:
+    """Interface-compatible with EnvRunner (sample/set_weights/metrics)."""
+
+    def __init__(self, config, worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        env_cfg = getattr(config, "env_config", None) or {}
+        self.env = make_env(config.env, env_cfg, worker_index=worker_index)
+        assert isinstance(self.env, MultiAgentEnv)
+        spec = getattr(config, "rl_module_spec", None) or RLModuleSpec(
+            observation_space=self.env.observation_space,
+            action_space=self.env.action_space,
+            model_config=dict(getattr(config, "model", None) or {}),
+            seed=(getattr(config, "seed", 0) or 0) + worker_index,
+        )
+        self.module = spec.build()
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._has_vf = getattr(self.module, "has_value_head", True)
+        self._vf_fn = (
+            jax.jit(lambda params, obs: self.module.apply(params, obs)[1])
+            if self._has_vf
+            else None
+        )
+        seed = (getattr(config, "seed", 0) or 0) * 7919 + worker_index
+        self._rng = jax.random.PRNGKey(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_counter = worker_index * 1_000_000
+        self._agent_eps = {
+            aid: self._new_eps_id(aid) for aid in self._obs
+        }
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._episode_returns: list = []
+        self._episode_lengths: list = []
+        self._steps_sampled = 0
+        self._global_timestep = 0
+        self._is_continuous = isinstance(self.env.action_space, Box)
+
+    def _new_eps_id(self, agent_id) -> int:
+        self._episode_counter += 1
+        return self._episode_counter
+
+    def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
+        T = int(
+            num_steps
+            or getattr(self.config, "rollout_fragment_length", None)
+            or 200
+        )
+        rows: dict[Any, dict[str, list]] = defaultdict(lambda: defaultdict(list))
+        env_steps = 0
+        while env_steps < T:
+            agents = sorted(self._obs.keys())
+            if not agents:
+                self._finish_episode()
+                continue
+            obs_stack = np.stack(
+                [np.asarray(self._obs[a], np.float32) for a in agents]
+            )
+            self._rng, key = jax.random.split(self._rng)
+            fwd_in = {SampleBatch.OBS: obs_stack}
+            fwd_in.update(
+                self.module.exploration_inputs(
+                    max(self._global_timestep, self._steps_sampled)
+                )
+            )
+            fwd = self._explore_fn(self.module.params, fwd_in, key)
+            actions = np.asarray(fwd[SampleBatch.ACTIONS])
+            env_actions = actions
+            if self._is_continuous:
+                env_actions = np.clip(
+                    actions, self.env.action_space.low, self.env.action_space.high
+                )
+            action_dict = {a: env_actions[i] for i, a in enumerate(agents)}
+            next_obs, rewards, terms, truncs, infos = self.env.step(action_dict)
+
+            for i, agent in enumerate(agents):
+                if agent not in rewards:
+                    continue  # agent was already done; env ignored the action
+                term = bool(terms.get(agent, False))
+                trunc = bool(truncs.get(agent, False))
+                r = rows[agent]
+                r[SampleBatch.OBS].append(obs_stack[i])
+                r[SampleBatch.ACTIONS].append(actions[i])
+                r[SampleBatch.REWARDS].append(np.float32(rewards[agent]))
+                r[SampleBatch.TERMINATEDS].append(term)
+                r[SampleBatch.TRUNCATEDS].append(trunc)
+                # Agents may first appear mid-episode (turn-based/spawning
+                # envs): give them an episode id on first sight.
+                if agent not in self._agent_eps:
+                    self._agent_eps[agent] = self._new_eps_id(agent)
+                r[SampleBatch.EPS_ID].append(self._agent_eps[agent])
+                for key_, val in fwd.items():
+                    if key_ != SampleBatch.ACTIONS:
+                        r[key_].append(np.asarray(val)[i])
+                successor = next_obs.get(agent)
+                if successor is None:
+                    successor = infos.get(agent, {}).get(
+                        "final_observation", obs_stack[i]
+                    )
+                r[SampleBatch.NEXT_OBS].append(np.asarray(successor, np.float32))
+                boot = 0.0
+                if trunc and self._vf_fn is not None:
+                    boot = float(
+                        np.asarray(
+                            self._vf_fn(
+                                self.module.params,
+                                np.asarray(successor, np.float32)[None],
+                            )
+                        )[0]
+                    )
+                r[SampleBatch.VALUES_BOOTSTRAPPED].append(np.float32(boot))
+                self._ep_return += float(rewards[agent])
+
+            env_steps += 1
+            self._ep_len += 1
+            self._obs = {
+                a: o
+                for a, o in next_obs.items()
+                if not (terms.get(a, False) or truncs.get(a, False))
+            }
+            if terms.get("__all__", False) or truncs.get("__all__", False) or not self._obs:
+                self._finish_episode()
+
+        batches = []
+        for agent, cols in rows.items():
+            if not cols[SampleBatch.OBS]:
+                continue
+            batch = SampleBatch(
+                {
+                    k: (np.stack(v) if k != SampleBatch.INFOS else v)
+                    for k, v in cols.items()
+                }
+            )
+            # Fragment-cut bootstrap for agents still running.
+            if (
+                self._vf_fn is not None
+                and not batch[SampleBatch.TERMINATEDS][-1]
+                and not batch[SampleBatch.TRUNCATEDS][-1]
+                and agent in self._obs
+            ):
+                val = float(
+                    np.asarray(
+                        self._vf_fn(
+                            self.module.params,
+                            np.asarray(self._obs[agent], np.float32)[None],
+                        )
+                    )[0]
+                )
+                vb = np.asarray(batch[SampleBatch.VALUES_BOOTSTRAPPED])
+                vb[-1] = val
+                batch[SampleBatch.VALUES_BOOTSTRAPPED] = vb
+            batches.append(batch)
+        out = SampleBatch.concat_samples(batches)
+        self._steps_sampled += env_steps
+        if getattr(self.config, "_compute_gae_on_runner", True) and self._has_vf:
+            out = compute_gae_for_sample_batch(
+                out,
+                gamma=getattr(self.config, "gamma", 0.99),
+                lambda_=getattr(self.config, "lambda_", 0.95),
+                use_gae=getattr(self.config, "use_gae", True),
+            )
+        return out
+
+    def _finish_episode(self) -> None:
+        self._episode_returns.append(self._ep_return)
+        self._episode_lengths.append(self._ep_len)
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._obs, _ = self.env.reset()
+        self._agent_eps = {a: self._new_eps_id(a) for a in self._obs}
+
+    # -- interface parity with EnvRunner ----------------------------------
+
+    def set_weights(self, weights: Any, global_vars: Optional[dict] = None) -> None:
+        self.module.set_state(weights)
+        if global_vars:
+            self._global_timestep = int(global_vars.get("timestep", 0))
+
+    def get_weights(self) -> Any:
+        return self.module.get_state()
+
+    def set_global_vars(self, global_vars: dict) -> None:
+        self._global_timestep = int(global_vars.get("timestep", 0))
+
+    def get_metrics(self) -> dict:
+        out = {
+            "episode_returns": self._episode_returns,
+            "episode_lengths": self._episode_lengths,
+            "num_env_steps_sampled": self._steps_sampled,
+        }
+        self._episode_returns = []
+        self._episode_lengths = []
+        return out
+
+    def spaces(self) -> tuple:
+        return self.env.observation_space, self.env.action_space
+
+    def stop(self) -> None:
+        self.env.close()
+
+    def ping(self) -> str:
+        return "pong"
+
+
+RemoteMultiAgentEnvRunner = ray_tpu.remote(MultiAgentEnvRunner)

@@ -19,19 +19,33 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch, concat_samples
 
 class EnvRunnerGroup:
     def __init__(self, config, local: bool = True):
+        from ray_tpu.rllib.evaluation.multi_agent_runner import (
+            MultiAgentEnvRunner,
+            RemoteMultiAgentEnvRunner,
+            is_multi_agent_env,
+        )
+
         self.config = config
         self.num_workers = int(getattr(config, "num_env_runners", 0) or 0)
         self.local_runner: Optional[EnvRunner] = None
         self._remote: dict[int, Any] = {}
         self._weights: Any = None
+        # Multi-agent envs sample through the shared-policy runner; the
+        # interface is identical so everything downstream is unchanged.
+        if is_multi_agent_env(config.env, getattr(config, "env_config", None) or {}):
+            self._runner_cls = MultiAgentEnvRunner
+            self._remote_runner_cls = RemoteMultiAgentEnvRunner
+        else:
+            self._runner_cls = EnvRunner
+            self._remote_runner_cls = RemoteEnvRunner
         if local or self.num_workers == 0:
-            self.local_runner = EnvRunner(config, worker_index=0)
+            self.local_runner = self._runner_cls(config, worker_index=0)
         for i in range(1, self.num_workers + 1):
             self._remote[i] = self._make_remote(i)
 
     def _make_remote(self, index: int):
         opts = {"num_cpus": getattr(self.config, "num_cpus_per_env_runner", 1)}
-        return RemoteEnvRunner.options(
+        return self._remote_runner_cls.options(
             max_restarts=0, **opts
         ).remote(self.config, index)
 

@@ -64,9 +64,18 @@ class ReplicaActor:
         if inspect.iscoroutine(result):
             asyncio.run(result)
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        multiplexed_model_id: str = "",
+    ) -> Any:
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
         with self._lock:
             self._num_ongoing += 1
+        token = _set_multiplexed_model_id(multiplexed_model_id)
         try:
             if method_name == "__call__":
                 target = self._callable
@@ -77,6 +86,9 @@ class ReplicaActor:
                 result = asyncio.run(result)
             return result
         finally:
+            from ray_tpu.serve.multiplex import _multiplexed_model_id
+
+            _multiplexed_model_id.reset(token)
             with self._lock:
                 self._num_ongoing -= 1
                 self._num_processed += 1

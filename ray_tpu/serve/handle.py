@@ -52,6 +52,10 @@ class Router:
         self._lock = threading.Condition()
         self._replicas: dict[str, Any] = {}
         self._in_flight: dict[str, int] = {}
+        from collections import OrderedDict
+
+        # model id -> replica tag (LRU-bounded; guarded by self._lock)
+        self._model_affinity: "OrderedDict[str, str]" = OrderedDict()
         self._version = -1
         self._queued = 0
         self._closed = False
@@ -115,15 +119,37 @@ class Router:
 
     # ---------------- request path ----------------
 
-    def assign(self, method_name: str, args: tuple, kwargs: dict) -> DeploymentResponse:
+    def assign(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        multiplexed_model_id: str = "",
+    ) -> DeploymentResponse:
         with self._lock:
             self._queued += 1
+            prefer = (
+                self._model_affinity.get(multiplexed_model_id)
+                if multiplexed_model_id
+                else None
+            )
         try:
-            tag, handle = self._pick_replica()
+            tag, handle = self._pick_replica(prefer=prefer)
         finally:
             with self._lock:
                 self._queued -= 1
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+        if multiplexed_model_id:
+            # Cache-affinity: later requests for this model prefer the
+            # replica that just (presumably) loaded it. LRU-bounded; recency
+            # refreshed on every assignment.
+            with self._lock:
+                self._model_affinity[multiplexed_model_id] = tag
+                self._model_affinity.move_to_end(multiplexed_model_id)
+                while len(self._model_affinity) > 256:
+                    self._model_affinity.popitem(last=False)
+        ref = handle.handle_request.remote(
+            method_name, args, kwargs, multiplexed_model_id
+        )
 
         # Decrement in-flight when the REPLY arrives, not when the caller
         # reads it — fire-and-forget .remote() must not pin slots forever
@@ -136,7 +162,7 @@ class Router:
         get_runtime().store.on_sealed(ref.id, _on_reply)
         return DeploymentResponse(ref)
 
-    def _pick_replica(self, timeout_s: float = 30.0):
+    def _pick_replica(self, timeout_s: float = 30.0, prefer: str = None):
         deadline = time.time() + timeout_s
         with self._lock:
             while True:
@@ -146,6 +172,15 @@ class Router:
                     if self._in_flight.get(tag, 0) < self._max_q
                 ]
                 if candidates:
+                    # Model-affinity: take the preferred replica when it has
+                    # capacity (multiplexing cache locality).
+                    if prefer is not None:
+                        for tag, h in candidates:
+                            if tag == prefer:
+                                self._in_flight[tag] = (
+                                    self._in_flight.get(tag, 0) + 1
+                                )
+                                return tag, h
                     if len(candidates) > 2:
                         candidates = random.sample(candidates, 2)
                     tag, h = min(
@@ -181,12 +216,14 @@ class DeploymentHandle:
         deployment: str,
         max_concurrent_queries: int = 100,
         method_name: str = "__call__",
+        multiplexed_model_id: str = "",
         _router: Optional[Router] = None,
     ):
         self._app = app
         self._deployment = deployment
         self._max_q = max_concurrent_queries
         self._method_name = method_name
+        self._model_id = multiplexed_model_id
         self._router = _router
 
     def _get_router(self) -> Router:
@@ -195,11 +232,23 @@ class DeploymentHandle:
         return self._router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._get_router().assign(self._method_name, args, kwargs)
+        return self._get_router().assign(
+            self._method_name, args, kwargs, self._model_id
+        )
 
-    def options(self, method_name: str) -> "DeploymentHandle":
+    def options(
+        self,
+        method_name: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
+    ) -> "DeploymentHandle":
         h = DeploymentHandle(
-            self._app, self._deployment, self._max_q, method_name,
+            self._app,
+            self._deployment,
+            self._max_q,
+            method_name if method_name is not None else self._method_name,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._model_id,
             _router=self._router,
         )
         return h
@@ -213,7 +262,13 @@ class DeploymentHandle:
         # Handles are serializable into replicas/tasks; router rebuilds lazily.
         return (
             DeploymentHandle,
-            (self._app, self._deployment, self._max_q, self._method_name),
+            (
+                self._app,
+                self._deployment,
+                self._max_q,
+                self._method_name,
+                self._model_id,
+            ),
         )
 
     def __repr__(self):

@@ -8,6 +8,7 @@ from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -38,6 +39,7 @@ ASHAScheduler = AsyncHyperBandScheduler
 __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandScheduler",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "FIFOScheduler",

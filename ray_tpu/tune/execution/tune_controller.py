@@ -267,7 +267,13 @@ class TuneController:
                 (
                     t
                     for t in self.trials
-                    if t.status in (Trial.PENDING, Trial.PAUSED)
+                    if t.status == Trial.PENDING
+                    or (
+                        t.status == Trial.PAUSED
+                        # Synchronous schedulers (HyperBand) hold paused
+                        # trials at a rung until the cohort decision lands.
+                        and self._scheduler.may_resume(t)
+                    )
                 ),
                 None,
             )

@@ -20,6 +20,7 @@ class ResultGrid:
                 error=t.error_msg,
                 path=t.local_dir,
                 metrics_history=t.results,
+                config=dict(t.config or {}),
             )
             for t in trials
         ]

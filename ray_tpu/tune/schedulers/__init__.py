@@ -48,6 +48,11 @@ class TrialScheduler:
     def on_trial_remove(self, trial: Trial) -> None:
         pass
 
+    def may_resume(self, trial: Trial) -> bool:
+        """Whether the controller may restart this PAUSED trial now
+        (synchronous schedulers hold rung members until the cohort lands)."""
+        return True
+
 
 class FIFOScheduler(TrialScheduler):
     """Run every trial to completion in submission order."""
@@ -248,3 +253,128 @@ class PopulationBasedTraining(TrialScheduler):
                 new_config = self._explore(dict(src.config))
                 self.pending_exploits[trial.trial_id] = (src, new_config)
         return TrialScheduler.CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: tune/schedulers/hyperband.py).
+
+    Trials are assigned round-robin to brackets of decreasing initial budget;
+    within a bracket, successive-halving keeps the top 1/eta of trials each
+    round and multiplies their budget by eta. Unlike ASHA, halving waits for
+    the whole bracket cohort to reach the milestone (paused trials resume when
+    the cohort decision lands), so no trial is judged on partial evidence.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+        brackets: int = 1,
+    ):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # Bracket b's cohort starts at budget max_t * eta^-(s_max - b):
+        # bracket 0 explores most aggressively (smallest initial budget).
+        self._bracket_budgets = [
+            max(1, int(round(max_t * reduction_factor ** (-(s_max - b)))))
+            for b in range(min(brackets, s_max + 1))
+        ]
+        self._next_bracket = 0
+        # (bracket, milestone) -> {trial id: score at that milestone}; keying
+        # by milestone keeps late-added trials out of veterans' rungs.
+        self._cohorts: Dict[tuple, dict] = defaultdict(dict)
+        self._bracket_of: Dict[str, int] = {}
+        self._milestone_of: Dict[str, int] = {}
+        self._trials: list = []
+        # Cohort losers that were PAUSED when the halving decision landed;
+        # they stop on their next report.
+        self._doomed: set = set()
+        # Rung members paused awaiting their cohort's halving decision.
+        self._held: set = set()
+
+    def on_trial_add(self, trial: Trial) -> None:
+        bracket = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % len(self._bracket_budgets)
+        self._bracket_of[trial.trial_id] = bracket
+        self._milestone_of[trial.trial_id] = self._bracket_budgets[bracket]
+        self._trials.append(trial)
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return TrialScheduler.CONTINUE
+        if trial.trial_id in self._doomed:
+            return TrialScheduler.STOP
+        t = result[self.time_attr]
+        if t >= self.max_t:
+            return TrialScheduler.STOP
+        milestone = self._milestone_of.get(trial.trial_id, self.max_t)
+        if t < milestone:
+            return TrialScheduler.CONTINUE
+        bracket = self._bracket_of.get(trial.trial_id, 0)
+        self._cohorts[(bracket, milestone)][trial.trial_id] = self._score(result)
+        self._maybe_halve(bracket, milestone)
+        if trial.trial_id in self._doomed:
+            self._doomed.discard(trial.trial_id)
+            return TrialScheduler.STOP
+        if self._milestone_of.get(trial.trial_id, milestone) > milestone:
+            return TrialScheduler.CONTINUE  # halving landed; promoted
+        self._held.add(trial.trial_id)
+        return TrialScheduler.PAUSE
+
+    def _maybe_halve(self, bracket: int, milestone: int) -> None:
+        """Run the rung's halving once every live member has reported.
+        Trials added after a halving sit at a smaller milestone and form
+        their own cohort (synchronous — the sole difference from ASHA)."""
+        cohort = self._cohorts.get((bracket, milestone))
+        if not cohort:
+            return
+        live = [
+            tr.trial_id
+            for tr in self._trials
+            if self._bracket_of.get(tr.trial_id) == bracket
+            and self._milestone_of.get(tr.trial_id) == milestone
+            and tr.status not in (Trial.TERMINATED, Trial.ERROR)
+        ] or list(cohort)
+        if not all(tid in cohort for tid in live):
+            return
+        scores = sorted(cohort.values(), reverse=True)
+        keep_n = max(1, int(len(scores) / self.eta))
+        cutoff = scores[keep_n - 1]
+        next_milestone = min(self.max_t, int(milestone * self.eta))
+        for tid, score in cohort.items():
+            self._milestone_of[tid] = next_milestone
+            self._held.discard(tid)
+            if score < cutoff:
+                self._doomed.add(tid)
+        del self._cohorts[(bracket, milestone)]
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]) -> None:
+        # A member erroring/finishing must not deadlock its rung: drop it and
+        # re-check whether the cohorts it gated can now halve.
+        self._held.discard(trial.trial_id)
+        self._doomed.discard(trial.trial_id)
+        bracket = self._bracket_of.get(trial.trial_id)
+        if bracket is None:
+            return
+        for (b, milestone) in list(self._cohorts):
+            if b == bracket:
+                self._cohorts[(b, milestone)].pop(trial.trial_id, None)
+                self._maybe_halve(b, milestone)
+
+    def may_resume(self, trial: Trial) -> bool:
+        # Doomed trials resume (to receive their STOP); held rung members
+        # wait for the cohort.
+        return trial.trial_id not in self._held
+
+    def on_trial_remove(self, trial: Trial) -> None:
+        # The controller also routes PAUSE through removal — a paused trial's
+        # milestone score must stay in the cohort or halving never fires.
+        # Terminal removals go through on_trial_complete.
+        if trial.status in (Trial.TERMINATED, Trial.ERROR):
+            self.on_trial_complete(trial, None)

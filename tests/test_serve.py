@@ -289,3 +289,86 @@ def test_graceful_shutdown_hook_runs(serve_instance, tmp_path):
     while time.time() < deadline and not marker.exists():
         time.sleep(0.1)
     assert marker.exists() and marker.read_text() == "clean"
+
+
+def test_model_multiplexing(serve_instance):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return {"id": model_id, "scale": int(model_id.split("-")[1])}
+
+        def __call__(self, x):
+            model = self.get_model()
+            return x * model["scale"], serve.get_multiplexed_model_id()
+
+    handle = serve.run(MultiModel.bind(), name="mux")
+    for mid, expect in (("m-2", 10), ("m-3", 15), ("m-2", 10), ("m-5", 25)):
+        out, seen = handle.options(multiplexed_model_id=mid).remote(5).result(
+            timeout_s=30
+        )
+        assert out == expect and seen == mid
+
+
+def test_multiplex_lru_eviction():
+    from ray_tpu.serve.multiplex import _ModelMultiplexWrapper
+
+    loads = []
+
+    def loader(owner, model_id):
+        loads.append(model_id)
+        return model_id.upper()
+
+    wrapper = _ModelMultiplexWrapper(loader, None, max_models=2)
+    assert wrapper("a") == "A"
+    assert wrapper("b") == "B"
+    assert wrapper("a") == "A"  # cache hit, no reload
+    assert loads == ["a", "b"]
+    wrapper("c")  # evicts LRU ("b")
+    wrapper("b")
+    assert loads == ["a", "b", "c", "b"]
+
+
+def test_multiplex_async_loader(serve_instance):
+    """Async loaders from async deployment methods (documented usage) must
+    work on cache misses (regression: nested asyncio.run crashed)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class AsyncMux:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return model_id.upper()
+
+        async def __call__(self):
+            return self.get_model()
+
+    handle = serve.run(AsyncMux.bind(), name="asyncmux")
+    out = handle.options(multiplexed_model_id="abc").remote().result(timeout_s=30)
+    assert out == "ABC"
+
+
+def test_multiplex_concurrent_load_once():
+    import threading
+    import time
+
+    from ray_tpu.serve.multiplex import _ModelMultiplexWrapper
+
+    loads = []
+
+    def slow_loader(owner, model_id):
+        loads.append(model_id)
+        time.sleep(0.2)
+        return model_id
+
+    wrapper = _ModelMultiplexWrapper(slow_loader, None, max_models=4)
+    threads = [
+        threading.Thread(target=lambda: wrapper("same")) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert loads == ["same"]  # one load despite 4 concurrent misses

@@ -394,3 +394,31 @@ def test_jax_trainer_with_tuner(ray_start_regular):
     results = tuner.fit()
     assert len(results) == 2
     assert results.get_best_result().metrics["dist"] < 0.1
+
+
+def test_hyperband_scheduler(ray_start_regular):
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    def trainable(config):
+        from ray_tpu.air import session
+
+        for i in range(30):
+            session.report({"score": config["base"] + i * 0.1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"base": tune.grid_search([0.0, 1.0, 2.0, 3.0])},
+        tune_config=tune.TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=HyperBandScheduler(max_t=27, reduction_factor=3),
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    # The strongest config survives to the end.
+    assert best.config["base"] == 3.0
+    # At least one weak trial stopped early.
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < max(iters)
