@@ -37,10 +37,14 @@ def is_multi_agent_env(env_spec, env_config) -> bool:
     if isinstance(env_spec, type):
         return issubclass(env_spec, MultiAgentEnv)
     key = None
-    if isinstance(env_spec, str):
+    try:
+        # str specs key by value; callables by identity (the cache holds the
+        # callable, keeping its id stable).
         key = (env_spec, repr(sorted((env_config or {}).items())))
         if key in _PROBE_CACHE:
             return _PROBE_CACHE[key]
+    except TypeError:
+        key = None  # unhashable spec: probe every time
     probe = make_env(env_spec, env_config)
     result = isinstance(probe, MultiAgentEnv)
     probe.close()
@@ -123,12 +127,18 @@ class MultiAgentEnvRunner:
                 )
             action_dict = {a: env_actions[i] for i, a in enumerate(agents)}
             next_obs, rewards, terms, truncs, infos = self.env.step(action_dict)
+            # "__all__" ends the episode for every live agent even when the
+            # env sets no per-agent flags — rows must reflect it or GAE
+            # bootstraps a truncated episode with 0 (and the fragment-cut
+            # path could leak the NEXT episode's value across the boundary).
+            all_term = bool(terms.get("__all__", False))
+            all_trunc = bool(truncs.get("__all__", False))
 
             for i, agent in enumerate(agents):
                 if agent not in rewards:
                     continue  # agent was already done; env ignored the action
-                term = bool(terms.get(agent, False))
-                trunc = bool(truncs.get(agent, False))
+                term = bool(terms.get(agent, False)) or all_term
+                trunc = (bool(truncs.get(agent, False)) or all_trunc) and not term
                 r = rows[agent]
                 r[SampleBatch.OBS].append(obs_stack[i])
                 r[SampleBatch.ACTIONS].append(actions[i])

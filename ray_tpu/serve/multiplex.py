@@ -64,15 +64,15 @@ class _ModelMultiplexWrapper:
     def _evict_locked(self) -> None:
         while len(self._models) > self._max:
             _, model = self._models.popitem(last=False)
-            # Best-effort unload hook (reference calls __del__).
-            for hook in ("__del__", "unload"):
-                fn = getattr(model, hook, None)
-                if fn is not None:
-                    try:
-                        fn()
-                    except Exception:
-                        pass
-                    break
+            # Best-effort unload hook. Deliberately NOT __del__: calling a
+            # dunder finalizer explicitly makes the GC run it a second time
+            # at refcount zero (double-free for device buffers).
+            fn = getattr(model, "unload", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass
 
     def __call__(self, model_id: Optional[str] = None) -> Any:
         model_id = model_id or get_multiplexed_model_id()
@@ -111,6 +111,9 @@ class _ModelMultiplexWrapper:
             return list(self._models)
 
 
+_DESCRIPTOR_LOCK = threading.Lock()
+
+
 class _MultiplexedDescriptor:
     """Descriptor so `self.get_model` resolves to one wrapper per instance."""
 
@@ -124,8 +127,14 @@ class _MultiplexedDescriptor:
             return self
         wrapper = getattr(obj, self._attr, None)
         if wrapper is None:
-            wrapper = _ModelMultiplexWrapper(self._loader, obj, self._max)
-            setattr(obj, self._attr, wrapper)
+            # Replicas call methods from a thread pool: exactly one wrapper
+            # per instance, or concurrent first requests each build their own
+            # LRU and double-load every model.
+            with _DESCRIPTOR_LOCK:
+                wrapper = getattr(obj, self._attr, None)
+                if wrapper is None:
+                    wrapper = _ModelMultiplexWrapper(self._loader, obj, self._max)
+                    setattr(obj, self._attr, wrapper)
         return wrapper
 
 

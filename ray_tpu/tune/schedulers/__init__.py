@@ -356,12 +356,17 @@ class HyperBandScheduler(TrialScheduler):
 
     def on_trial_complete(self, trial: Trial, result: Optional[dict]) -> None:
         # A member erroring/finishing must not deadlock its rung: drop it and
-        # re-check whether the cohorts it gated can now halve.
+        # re-check whether the cohorts it gated can now halve. Terminal trials
+        # also leave the tracking maps so long experiments don't grow them
+        # (and _maybe_halve's live scan stays proportional to live trials).
         self._held.discard(trial.trial_id)
         self._doomed.discard(trial.trial_id)
         bracket = self._bracket_of.get(trial.trial_id)
         if bracket is None:
             return
+        self._trials = [t for t in self._trials if t.trial_id != trial.trial_id]
+        self._bracket_of.pop(trial.trial_id, None)
+        self._milestone_of.pop(trial.trial_id, None)
         for (b, milestone) in list(self._cohorts):
             if b == bracket:
                 self._cohorts[(b, milestone)].pop(trial.trial_id, None)

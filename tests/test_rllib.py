@@ -584,3 +584,43 @@ def test_multi_agent_runner_eps_ids():
     # Agents have distinct episode ids.
     assert len(set(np.asarray(batch[SampleBatch.EPS_ID]).tolist())) >= 3
     assert SampleBatch.ADVANTAGES in batch
+
+
+def test_multi_agent_all_done_flag_marks_rows():
+    """__all__-only episode ends must mark every live agent's rows done
+    (regression: rows stayed non-terminal, corrupting GAE bootstraps)."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.env import MultiAgentEnv, register_env
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    class AllDoneEnv(MultiAgentEnv):
+        def __init__(self, cfg=None):
+            self.observation_space = Box(-1, 1, shape=(2,))
+            self.action_space = Discrete(2)
+            self._t = 0
+
+        def reset(self, *, seed=None):
+            self._t = 0
+            obs = {"a": np.zeros(2, np.float32), "b": np.zeros(2, np.float32)}
+            return obs, {a: {} for a in obs}
+
+        def step(self, actions):
+            self._t += 1
+            obs = {a: np.zeros(2, np.float32) for a in actions}
+            rews = {a: 1.0 for a in actions}
+            # No per-agent flags, only __all__ at t=3.
+            done = self._t >= 3
+            return obs, rews, {"__all__": done}, {"__all__": False}, {a: {} for a in actions}
+
+    register_env("AllDoneEnv", lambda cfg: AllDoneEnv(cfg))
+    from ray_tpu.rllib.evaluation.multi_agent_runner import MultiAgentEnvRunner
+
+    cfg = PPOConfig().environment("AllDoneEnv").env_runners(rollout_fragment_length=6)
+    runner = MultiAgentEnvRunner(cfg)
+    batch = runner.sample(6)
+    terms = np.asarray(batch[SampleBatch.TERMINATEDS])
+    eps = np.asarray(batch[SampleBatch.EPS_ID])
+    # Every episode's last row is terminal.
+    for e in set(eps.tolist()):
+        rows = np.nonzero(eps == e)[0]
+        assert terms[rows[-1]], "episode end not marked on agent rows"
