@@ -6,6 +6,15 @@ the core task/actor/object API and control plane live here; the ML libraries
 the reference's single most important layering rule (SURVEY.md §overview).
 """
 
+import os as _os
+
+# This image's pyarrow ships a jemalloc default memory pool that intermittently
+# corrupts itself under heavy thread churn (reproducible: runtime shutdown's
+# pool-thread exits followed by any arrow call segfaults in ~70% of runs;
+# 0% with the system allocator). Must be set before pyarrow's first import —
+# ray_tpu imports precede data use, so here.
+_os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
 from ray_tpu import exceptions
 from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.api import (

@@ -12,18 +12,16 @@ feeding format for `iter_batches(batch_format="numpy")` → `jax.device_put`.
 from __future__ import annotations
 
 import sys
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Union
 
 import numpy as np
 
-# pyarrow table construction from numpy segfaults sporadically when entered
-# from many worker threads at once (observed with pa.Table.from_pydict under
-# the thread-pool executor); arrow conversions are cheap relative to the IO
-# they precede, so serialize them.
-_ARROW_BUILD_LOCK = threading.Lock()
+# NOTE: arrow calls here run concurrently from task threads. That is safe
+# ONLY because ray_tpu/__init__.py forces ARROW_DEFAULT_MEMORY_POOL=system —
+# this image's bundled jemalloc pool corrupts itself under thread churn and
+# segfaults in arbitrary later arrow/pandas calls.
 
 Block = Any  # list | pyarrow.Table | pandas.DataFrame | dict[str, np.ndarray]
 
@@ -92,17 +90,9 @@ class BlockAccessor:
         return pd.DataFrame(self.to_numpy_dict())
 
     def to_arrow(self):
-        with _ARROW_BUILD_LOCK:
-            return self.to_arrow_locked()
-
-    def to_arrow_locked(self):
-        """Arrow conversion for callers already holding _ARROW_BUILD_LOCK
-        (the lock is not reentrant)."""
         import pyarrow as pa
 
-        return pa.Table.from_pydict(
-            {k: v for k, v in self.to_numpy_dict().items()}
-        )
+        return pa.Table.from_pydict(dict(self.to_numpy_dict()))
 
     def take_columns(self, keys) -> Block:
         d = self.to_numpy_dict()
@@ -201,9 +191,6 @@ class ArrowBlockAccessor(BlockAccessor):
         }
 
     def to_arrow(self):
-        return self._block
-
-    def to_arrow_locked(self):
         return self._block
 
     def to_pandas(self):

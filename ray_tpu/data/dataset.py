@@ -379,14 +379,7 @@ class Dataset:
         def _w(acc, p):
             import pyarrow.parquet as pq
 
-            from ray_tpu.data.block import _ARROW_BUILD_LOCK
-
-            # This pyarrow build segfaults when ParquetWriter construction
-            # runs concurrently with Table building on another thread; the
-            # whole arrow write is serialized behind the shared lock.
-            with _ARROW_BUILD_LOCK:
-                table = acc.to_arrow_locked()
-                pq.write_table(table, p)
+            pq.write_table(acc.to_arrow(), p)
 
         return self._write(path, _w, "parquet")
 

@@ -41,6 +41,7 @@ class AlgorithmConfig:
         self.num_cpus_per_env_runner: float = 1
         self.rollout_fragment_length: Optional[int] = None
         self.restart_failed_env_runners: bool = True
+        self.observation_filter: Optional[str] = None  # "MeanStdFilter"
         # training
         self.gamma: float = 0.99
         self.lr: float = 5e-4
@@ -53,6 +54,8 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         self.num_cpus_per_learner: float = 1
         self.num_tpus_per_learner: float = 0
+        # offline IO: directory to tee sampled rollouts into (JsonWriter)
+        self.output: Optional[str] = None
         # debugging / reproducibility
         self.seed: Optional[int] = 0
         # internal
@@ -76,6 +79,7 @@ class AlgorithmConfig:
         rollout_fragment_length: Optional[int] = None,
         num_cpus_per_env_runner: Optional[float] = None,
         restart_failed_env_runners: Optional[bool] = None,
+        observation_filter: Optional[str] = None,
     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -87,6 +91,8 @@ class AlgorithmConfig:
             self.num_cpus_per_env_runner = num_cpus_per_env_runner
         if restart_failed_env_runners is not None:
             self.restart_failed_env_runners = restart_failed_env_runners
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -209,6 +215,11 @@ class Algorithm(Trainable):
         self.learner_group = cfg.build_learner_group(spec)
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._env_steps_total = 0
+        self._output_writer = None
+        if getattr(cfg, "output", None):
+            from ray_tpu.rllib.offline import JsonWriter
+
+            self._output_writer = JsonWriter(cfg.output)
 
     def step(self) -> dict:
         results = self.training_step()
@@ -228,6 +239,8 @@ class Algorithm(Trainable):
             batches.append(batch)
             count += batch.count
         train_batch = concat_samples(batches)
+        if self._output_writer is not None:
+            self._output_writer.write(train_batch)
         self._env_steps_total += train_batch.count
         learner_results = self.learner_group.update(train_batch)
         self.env_runner_group.sync_weights(
@@ -239,14 +252,22 @@ class Algorithm(Trainable):
     # -- checkpointing -----------------------------------------------------
 
     def save_checkpoint(self) -> Optional[dict]:
-        return {"learner": self.learner_group.get_state()}
+        return {
+            "learner": self.learner_group.get_state(),
+            # Policies trained on normalized observations are garbage without
+            # their filter stats; restore must bring them back together.
+            "obs_filter": self.env_runner_group.get_filter_state(),
+        }
 
     def load_checkpoint(self, state: Optional[dict]) -> None:
         if state:
             self.learner_group.set_state(state["learner"])
+            self.env_runner_group.set_filter_state(state.get("obs_filter"))
             self.env_runner_group.sync_weights(self.learner_group.get_weights())
 
     def cleanup(self) -> None:
+        if getattr(self, "_output_writer", None) is not None:
+            self._output_writer.close()
         self.env_runner_group.stop()
         self.learner_group.shutdown()
 
@@ -263,6 +284,8 @@ class Algorithm(Trainable):
         runner = self.env_runner_group.local_runner
         assert runner is not None
         obs = np.asarray(obs, dtype=np.float32)[None]
+        if hasattr(runner, "transform_obs"):
+            obs = runner.transform_obs(obs)
         if explore:
             import jax
 

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig, BCLearner
+
+__all__ = ["BC", "BCConfig", "BCLearner"]

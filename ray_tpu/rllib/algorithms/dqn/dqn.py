@@ -247,6 +247,8 @@ class DQN(Algorithm):
     def training_step(self) -> dict:
         cfg = self.algo_config
         rollout = self.env_runner_group.sample(cfg.get_rollout_fragment_length())
+        if self._output_writer is not None:
+            self._output_writer.write(rollout)
         self.replay_buffer.add(
             n_step_transitions(rollout, cfg.n_step, cfg.gamma)
         )

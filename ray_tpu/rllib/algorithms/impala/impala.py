@@ -131,6 +131,8 @@ class IMPALA(Algorithm):
                 batches.append(b)
                 count += b.count
             train_batch = concat_samples(batches)
+            if self._output_writer is not None:
+                self._output_writer.write(train_batch)
             self._env_steps_total += train_batch.count
             results = self.learner_group.update(train_batch)
             group.sync_weights(
@@ -173,6 +175,8 @@ class IMPALA(Algorithm):
         if not batches:
             raise RuntimeError("no rollout fragments received")
         train_batch = concat_samples(batches)
+        if self._output_writer is not None:
+            self._output_writer.write(train_batch)
         self._env_steps_total += train_batch.count
         results = self.learner_group.update(train_batch)
 

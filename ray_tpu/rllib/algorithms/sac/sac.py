@@ -264,6 +264,8 @@ class SAC(Algorithm):
         rollout = self.env_runner_group.sample(
             max(1, cfg.rollout_fragment_length or 1)
         )
+        if self._output_writer is not None:
+            self._output_writer.write(rollout)
         self.replay_buffer.add(rollout)
         self._env_steps_total += rollout.count
         results = {"replay_buffer_size": len(self.replay_buffer)}

@@ -74,6 +74,12 @@ class EnvRunner:
         self._steps_sampled = 0
         self._global_timestep = 0  # cluster-wide env steps, pushed by the algo
         self._is_continuous = isinstance(self.vector_env.action_space, Box)
+        from ray_tpu.rllib.connectors import make_observation_filter
+
+        self.obs_filter = make_observation_filter(
+            getattr(config, "observation_filter", None),
+            self.vector_env.observation_space.shape,
+        )
 
     # -- sampling ----------------------------------------------------------
 
@@ -89,6 +95,10 @@ class EnvRunner:
         for _ in range(T):
             self._rng, key = jax.random.split(self._rng)
             obs = self._obs.astype(np.float32)
+            if self.obs_filter is not None:
+                # Rows store FILTERED observations: the learner must see the
+                # same inputs the policy acted on.
+                obs = self.obs_filter(obs, update=True)
             fwd_in = {SampleBatch.OBS: obs}
             # Module-specific exploration knobs (epsilon etc.) enter the
             # jitted forward as traced inputs, so schedules never retrace.
@@ -128,7 +138,10 @@ class EnvRunner:
                         next_obs_rec[i] = fin
             else:
                 next_obs_rec = next_obs
-            cols[SampleBatch.NEXT_OBS].append(next_obs_rec.astype(np.float32))
+            next_obs_rec = next_obs_rec.astype(np.float32)
+            if self.obs_filter is not None:
+                next_obs_rec = self.obs_filter(next_obs_rec, update=False)
+            cols[SampleBatch.NEXT_OBS].append(next_obs_rec)
             cols[SampleBatch.EPS_ID].append(self._eps_id.copy())
             if self._vf_fn is not None:
                 # Truncation bootstrap: V(final_observation) where trunc hit.
@@ -143,6 +156,8 @@ class EnvRunner:
                             for i in range(B)
                         ]
                     )
+                    if self.obs_filter is not None:
+                        finals = self.obs_filter(finals, update=False)
                     vals = np.asarray(self._vf_fn(self.module.params, finals))
                     boot = np.where(truncs, vals, 0.0).astype(np.float32)
                 cols[SampleBatch.VALUES_BOOTSTRAPPED].append(boot)
@@ -160,9 +175,10 @@ class EnvRunner:
         # Fragment cut: running episodes bootstrap from V(current obs).
         running = ~(cols[SampleBatch.TERMINATEDS][-1] | cols[SampleBatch.TRUNCATEDS][-1])
         if self._vf_fn is not None and running.any():
-            vals = np.asarray(
-                self._vf_fn(self.module.params, self._obs.astype(np.float32))
-            )
+            cut_obs = self._obs.astype(np.float32)
+            if self.obs_filter is not None:
+                cut_obs = self.obs_filter(cut_obs, update=False)
+            vals = np.asarray(self._vf_fn(self.module.params, cut_obs))
             last = cols[SampleBatch.VALUES_BOOTSTRAPPED][-1]
             cols[SampleBatch.VALUES_BOOTSTRAPPED][-1] = np.where(
                 running, vals, last
@@ -197,6 +213,21 @@ class EnvRunner:
 
     def get_weights(self) -> Any:
         return self.module.get_state()
+
+    def get_filter_delta(self) -> Optional[dict]:
+        if self.obs_filter is None:
+            return None
+        return self.obs_filter.flush_delta()
+
+    def set_filter_state(self, state: dict) -> None:
+        if self.obs_filter is not None:
+            self.obs_filter.set_global(state)
+
+    def transform_obs(self, obs: "np.ndarray") -> "np.ndarray":
+        """Inference-path normalization (compute_single_action)."""
+        if self.obs_filter is None:
+            return obs
+        return self.obs_filter(obs, update=False)
 
     def get_metrics(self) -> dict:
         """Drain episode stats (reference: collect_metrics /

@@ -68,6 +68,10 @@ class MultiAgentEnvRunner:
             model_config=dict(getattr(config, "model", None) or {}),
             seed=(getattr(config, "seed", 0) or 0) + worker_index,
         )
+        if getattr(config, "observation_filter", None) not in (None, "NoFilter"):
+            raise ValueError(
+                "observation_filter is not supported for multi-agent envs yet"
+            )
         self.module = spec.build()
         self._explore_fn = jax.jit(self.module.forward_exploration)
         self._has_vf = getattr(self.module, "has_value_head", True)
@@ -242,6 +246,15 @@ class MultiAgentEnvRunner:
 
     def set_global_vars(self, global_vars: dict) -> None:
         self._global_timestep = int(global_vars.get("timestep", 0))
+
+    def get_filter_delta(self):
+        return None  # filters rejected at construction for multi-agent
+
+    def set_filter_state(self, state) -> None:
+        pass
+
+    def transform_obs(self, obs):
+        return obs
 
     def get_metrics(self) -> dict:
         out = {

@@ -30,6 +30,7 @@ class EnvRunnerGroup:
         self.local_runner: Optional[EnvRunner] = None
         self._remote: dict[int, Any] = {}
         self._weights: Any = None
+        self._global_filter_stat = None
         # Multi-agent envs sample through the shared-policy runner; the
         # interface is identical so everything downstream is unchanged.
         if is_multi_agent_env(config.env, getattr(config, "env_config", None) or {}):
@@ -116,6 +117,62 @@ class EnvRunnerGroup:
             ref = ray_tpu.put(weights)
             for runner in targets.values():
                 runner.set_weights.remote(ref, global_vars)
+        self._sync_obs_filters(to)
+
+    def _sync_obs_filters(self, to: Optional[list] = None) -> None:
+        """Merge per-runner observation-filter deltas into the global stat
+        and broadcast it (reference: WorkerSet filter synchronization via
+        utils/filter.py apply_changes). Restricted to `to` when given —
+        querying a runner with a sample() in flight would serialize async
+        pipelines behind the slowest fragment."""
+        if getattr(self.config, "observation_filter", None) in (None, "NoFilter"):
+            return
+        from ray_tpu.rllib.connectors import RunningStat
+
+        targets = self._remote if to is None else {
+            i: self._remote[i] for i in to if i in self._remote
+        }
+        deltas = []
+        if self.local_runner is not None:
+            deltas.append(self.local_runner.get_filter_delta())
+        failed = []
+        refs = [(idx, r.get_filter_delta.remote()) for idx, r in targets.items()]
+        for idx, ref in refs:
+            try:
+                deltas.append(ray_tpu.get(ref, timeout=120.0))
+            except Exception:
+                failed.append(idx)
+        self._handle_failures(failed)
+        deltas = [d for d in deltas if d]
+        if not deltas:
+            return
+        if self._global_filter_stat is None:
+            self._global_filter_stat = RunningStat(deltas[0]["shape"])
+        for delta in deltas:
+            self._global_filter_stat.merge(RunningStat.from_state(delta))
+        state = self._global_filter_stat.to_state()
+        if self.local_runner is not None:
+            self.local_runner.set_filter_state(state)
+        for runner in targets.values():
+            runner.set_filter_state.remote(state)
+
+    def get_filter_state(self) -> Optional[dict]:
+        """Authoritative filter stat for checkpointing (deltas flushed)."""
+        self._sync_obs_filters()
+        if self._global_filter_stat is None:
+            return None
+        return self._global_filter_stat.to_state()
+
+    def set_filter_state(self, state: Optional[dict]) -> None:
+        if state is None:
+            return
+        from ray_tpu.rllib.connectors import RunningStat
+
+        self._global_filter_stat = RunningStat.from_state(state)
+        if self.local_runner is not None:
+            self.local_runner.set_filter_state(state)
+        for runner in self._remote.values():
+            runner.set_filter_state.remote(state)
 
     def remote_runners(self) -> dict:
         """Live remote runners keyed by worker index (read-only view)."""
