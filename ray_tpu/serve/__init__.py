@@ -29,6 +29,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve import schema
 
 __all__ = [
     "Application",

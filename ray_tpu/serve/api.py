@@ -57,6 +57,8 @@ class Deployment:
         autoscaling_config: Optional[Union[AutoscalingConfig, dict]] = None,
         user_config: Any = None,
         ray_actor_options: Optional[dict] = None,
+        health_check_period_s: Optional[float] = None,
+        graceful_shutdown_timeout_s: Optional[float] = None,
     ) -> "Deployment":
         cfg = replace(self._config)
         if num_replicas is not None:
@@ -71,6 +73,10 @@ class Deployment:
             cfg.user_config = user_config
         if ray_actor_options is not None:
             cfg.ray_actor_options = ray_actor_options
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         return Deployment(self._callable_def, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:

@@ -372,3 +372,88 @@ def test_multiplex_concurrent_load_once():
     for t in threads:
         t.join()
     assert loads == ["same"]  # one load despite 4 concurrent misses
+
+
+def test_declarative_config_apply(serve_instance, tmp_path):
+    """GitOps-style deploy: applications by import path with per-deployment
+    overrides (reference deploy_apps/ServeDeploySchema)."""
+    import sys
+    import textwrap
+
+    from ray_tpu import serve
+
+    mod_dir = tmp_path / "apps"
+    mod_dir.mkdir()
+    (mod_dir / "my_serve_app.py").write_text(
+        textwrap.dedent(
+            """
+            from ray_tpu import serve
+
+            @serve.deployment
+            class Echo:
+                def __init__(self, prefix="e"):
+                    self.prefix = prefix
+                    self.tag = "default"
+
+                def reconfigure(self, user_config):
+                    self.tag = user_config.get("tag", "default")
+
+                def __call__(self, x):
+                    return f"{self.prefix}:{x}:{self.tag}"
+
+            app = Echo.bind("cfg")
+
+            def build_app(prefix="built"):
+                return Echo.bind(prefix)
+            """
+        )
+    )
+    sys.path.insert(0, str(mod_dir))
+    try:
+        config = {
+            "applications": [
+                {
+                    "name": "echo-app",
+                    "import_path": "my_serve_app:app",
+                    "deployments": [
+                        {
+                            "name": "Echo",
+                            "num_replicas": 2,
+                            "user_config": {"tag": "from-config"},
+                        }
+                    ],
+                },
+                {
+                    "name": "built-app",
+                    "import_path": "my_serve_app:build_app",
+                    "args": {"prefix": "B"},
+                },
+            ]
+        }
+        handles = serve.schema.apply(config)
+        out = handles["echo-app"].remote("hi").result(timeout_s=30)
+        assert out == "cfg:hi:from-config"
+        out2 = handles["built-app"].remote("yo").result(timeout_s=30)
+        assert out2 == "B:yo:default"
+        # Unknown deployment override fails loudly.
+        bad = {"applications": [{"name": "x", "import_path": "my_serve_app:app",
+                                 "deployments": [{"name": "Nope", "num_replicas": 1}]}]}
+        with pytest.raises(ValueError, match="unknown deployment"):
+            serve.schema.apply(bad)
+        # args on an already-bound target fails loudly (would be ignored).
+        with pytest.raises(ValueError, match="already bound"):
+            serve.schema.apply({"applications": [
+                {"name": "y", "import_path": "my_serve_app:app",
+                 "args": {"prefix": "Z"}}]})
+        # Duplicate app names rejected.
+        with pytest.raises(ValueError, match="Duplicate"):
+            serve.schema.apply({"applications": [
+                {"import_path": "my_serve_app:app"},
+                {"import_path": "my_serve_app:app"}]})
+        # Overrides never leak into the module-level Application.
+        import my_serve_app
+
+        assert my_serve_app.app.deployment._config.num_replicas == 1
+    finally:
+        sys.path.remove(str(mod_dir))
+        sys.modules.pop("my_serve_app", None)
