@@ -45,6 +45,7 @@ __all__ = [
     "get_multiplexed_model_id",
     "multiplexed",
     "run",
+    "schema",
     "shutdown",
     "status",
 ]

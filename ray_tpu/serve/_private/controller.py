@@ -211,7 +211,50 @@ class ServeControllerActor:
             ]
         for st in states:
             self._poll_metrics(st)
+            self._health_check(st)
             self._scale(st)
+
+    def _health_check(self, st: "_DeploymentState") -> None:
+        """Probe user check_health on the deployment's configured period;
+        a False return or a dead actor drops the replica (scaling replaces
+        it). Reference: deployment_state.py replica health checking."""
+        from ray_tpu import api as ray
+        from ray_tpu.exceptions import ActorDiedError
+
+        cfg = st.info["config"]
+        period = float(getattr(cfg, "health_check_period_s", 1.0) or 0)
+        if period <= 0:
+            return
+        if not hasattr(st, "last_health"):
+            st.last_health = {}
+        now = time.time()
+        due = {}
+        with self._lock:
+            for tag, h in st.replicas.items():
+                if now - st.last_health.get(tag, 0.0) >= period:
+                    st.last_health[tag] = now
+                    try:
+                        due[tag] = h.check_health.remote()
+                    except Exception:
+                        pass
+        for tag, ref in due.items():
+            healthy = True
+            try:
+                healthy = bool(ray.get(ref, timeout=2.0))
+            except ActorDiedError:
+                healthy = False
+            except Exception:
+                pass  # transient (slow init): keep the replica
+            if not healthy:
+                with self._lock:
+                    h = st.replicas.pop(tag, None)
+                    st.last_health.pop(tag, None)
+                    self._bump()
+                if h is not None:
+                    try:
+                        ray.kill(h)
+                    except Exception:
+                        pass
 
     def _poll_metrics(self, st: _DeploymentState) -> None:
         from ray_tpu import api as ray

@@ -35,6 +35,10 @@ def _validate(config: dict) -> List[dict]:
         raise ValueError("serve config needs a non-empty 'applications' list")
     seen_names: set = set()
     for app in apps:
+        if not isinstance(app, dict):
+            raise ValueError(
+                f"each applications entry must be a dict, got {app!r}"
+            )
         if "import_path" not in app:
             raise ValueError(f"application {app.get('name')!r} needs import_path")
         if ":" not in app["import_path"]:

@@ -293,3 +293,55 @@ def test_streaming_generator_killed_actor_does_not_hang(ray_start_regular):
     with pytest.raises(ActorDiedError):
         for _ in range(200):
             ray_tpu.get(next(gen), timeout=10.0)
+
+
+# -- util.iter parallel iterators -----------------------------------------
+
+
+def test_parallel_iterator_transforms(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    it = (
+        par_iter.from_range(20, num_shards=4)
+        .for_each(lambda x: x * 2)
+        .filter(lambda x: x % 4 == 0)
+    )
+    out = sorted(it.gather_sync())
+    assert out == sorted(x * 2 for x in range(20) if (x * 2) % 4 == 0)
+
+
+def test_parallel_iterator_batch_flatten(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    batched = par_iter.from_items(list(range(10)), num_shards=2).batch(3)
+    batches = list(batched.gather_sync())
+    assert all(len(b) <= 3 for b in batches)
+    flat = sorted(
+        par_iter.from_items([[1, 2], [3], [4, 5]], num_shards=2)
+        .flatten()
+        .gather_sync()
+    )
+    assert flat == [1, 2, 3, 4, 5]
+
+
+def test_parallel_iterator_async_and_take(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    it = par_iter.from_range(100, num_shards=4).for_each(lambda x: x + 1)
+    assert sorted(it.gather_async()) == list(range(1, 101))
+    assert len(par_iter.from_range(50, num_shards=2).take(7)) == 7
+    assert par_iter.from_range(13, num_shards=3).count() == 13
+
+
+def test_parallel_iterator_from_iterators(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    def make_gen(start):
+        def gen():
+            for i in range(3):
+                yield start + i
+
+        return gen
+
+    it = par_iter.from_iterators([make_gen(0), make_gen(100)])
+    assert sorted(it.gather_sync()) == [0, 1, 2, 100, 101, 102]
