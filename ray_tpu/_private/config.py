@@ -47,6 +47,10 @@ class Config:
     # Copy (serialize/deserialize) task args even in the in-process engine so
     # mutation bugs surface in tests; direct zero-copy handoff when False.
     inproc_copy_args: bool = False
+    # Store sealed objects as serialized bytes so every `get` returns a fresh
+    # copy (the reference's immutability contract). False = zero-copy sharing
+    # between thread-workers (fast, but mutations alias).
+    serialize_objects: bool = True
     # Native shared-memory store (src/store/, plasma equivalent): objects at
     # least this large go to shm; 0 disables. Requires the C++ lib to build.
     native_store_threshold: int = 512 * 1024
@@ -56,6 +60,13 @@ class Config:
     # raylet local_object_manager + external_storage.py).
     object_spilling_enabled: bool = True
     object_spill_directory: str = ""
+    # Worker isolation: "thread" (in-process engine, fast) or "process"
+    # (real OS worker processes with serialization + fate-sharing — the
+    # reference's execution model; env override RAY_TPU_ISOLATION).
+    isolation: str = "thread"
+    # JAX platform forced into process-isolated workers ("" = inherit the
+    # driver's environment, including any TPU plugin registration).
+    worker_jax_platform: str = "cpu"
     # Worker pool
     prestart_workers: bool = True
     idle_worker_killing_time_s: float = 60.0

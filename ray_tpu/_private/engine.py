@@ -43,6 +43,10 @@ class WorkerContext(threading.local):
 
 CONTEXT = WorkerContext()
 
+# Sentinel result value: the executing worker already sealed the return into
+# the shared store (process-isolation shm path); _seal_returns must skip it.
+SEALED_EXTERNALLY = object()
+
 
 class TaskResult:
     __slots__ = ("value", "exc", "traceback_str", "cancelled")

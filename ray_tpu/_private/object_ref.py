@@ -9,9 +9,29 @@ Python object liveness — the borrow protocol for the in-process engine.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 from ray_tpu._private.ids import ObjectID
+
+_CAPTURE = threading.local()
+
+
+@contextlib.contextmanager
+def capture_serialized_refs(out: list):
+    """Collect every ObjectRef serialized while the context is active.
+
+    The store wraps seal-time serialization with this so a ref nested inside a
+    stored value is an explicit borrow: the entry holds the captured handles,
+    keeping the inner object alive for the outer object's lifetime
+    (reference: ReferenceCounter nested-object sets, reference_count.h)."""
+    prev = getattr(_CAPTURE, "refs", None)
+    _CAPTURE.refs = out
+    try:
+        yield out
+    finally:
+        _CAPTURE.refs = prev
 
 
 def _global_runtime():
@@ -62,6 +82,9 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        refs = getattr(_CAPTURE, "refs", None)
+        if refs is not None:
+            refs.append(self)
         # Deserialization takes its own local reference (the borrow).
         return (ObjectRef, (self._id,))
 

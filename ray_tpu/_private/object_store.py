@@ -45,6 +45,18 @@ def _sizeof(value: Any) -> int:
     return sys.getsizeof(value)
 
 
+class _Pickled:
+    """Sealed value held as serialized bytes: every `get` deserializes a fresh
+    copy, enforcing the reference's object-immutability contract (a reader
+    mutating a `get` result can never corrupt other readers). Values that
+    fail to serialize are stored live as a documented escape hatch."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
 class _Entry:
     __slots__ = (
         "value",
@@ -56,6 +68,7 @@ class _Entry:
         "callbacks",
         "in_native",
         "spilled_uri",
+        "nested_refs",
     )
 
     def __init__(self):
@@ -68,6 +81,9 @@ class _Entry:
         self.callbacks: list[Callable[[], None]] = []
         self.in_native = False
         self.spilled_uri: str | None = None
+        # ObjectRef handles serialized inside this value (borrows): held for
+        # the entry's lifetime so the inner objects can't be collected.
+        self.nested_refs: list | None = None
 
 
 class InProcessStore:
@@ -84,7 +100,9 @@ class InProcessStore:
         native=None,
         native_threshold: int = 0,
         spill_storage=None,
+        serialize: bool = True,
     ):
+        self._serialize = serialize
         self._lock = threading.Lock()
         self._entries: dict[ObjectID, _Entry] = {}
         self._budget = memory_budget
@@ -103,18 +121,37 @@ class InProcessStore:
 
     def seal(self, object_id: ObjectID, value: Any) -> None:
         """Create-and-seal in one step (the in-process store has no partial create)."""
+        from ray_tpu._private.object_ref import capture_serialized_refs
+
         size = _sizeof(value)
         in_native = False
+        nested: list = []
+        # Entries evicted while we hold the lock are parked here so their
+        # nested_refs (whose GC re-enters this store via the refcounter) are
+        # dropped only after the lock is released.
+        dropped: list = []
         if self._native_threshold and size >= self._native_threshold:
             # Serialize into shm before taking the table lock (expensive);
             # idempotent reseal is handled natively (-1 == exists).
             try:
-                self._native.put_object(object_id, value)
+                with capture_serialized_refs(nested):
+                    self._native.put_object(object_id, value)
                 self._native.pin(object_id)  # owner pin: not LRU-evictable
                 in_native = True
                 value = None
             except MemoryError:
+                nested.clear()
                 pass  # shm full: keep the python copy
+        if not in_native and self._serialize:
+            try:
+                import cloudpickle
+
+                with capture_serialized_refs(nested):
+                    data = cloudpickle.dumps(value, protocol=5)
+                value = _Pickled(data)
+                size = len(data)
+            except Exception:
+                nested.clear()  # unpicklable: store live (aliasing escape hatch)
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
@@ -125,19 +162,68 @@ class InProcessStore:
                 if in_native:
                     self._native.unpin_and_delete(object_id)
                 return
+            # shm-resident bytes are governed by the shm capacity/LRU, not the
+            # python-side budget — account them at zero here so they can't
+            # trigger spurious in-process eviction/spilling pressure.
+            if in_native:
+                size = 0
             if self._budget is not None and self._used + size > self._budget:
-                self._evict_locked(self._used + size - self._budget)
+                self._evict_locked(self._used + size - self._budget, dropped)
             entry.value = value
             entry.size = size
             entry.sealed = True
             entry.freed = False
             entry.in_native = in_native
+            entry.nested_refs = nested or None
             entry.last_access = time.monotonic()
             self._used += size
             entry.event.set()
             callbacks, entry.callbacks = entry.callbacks, []
         for cb in callbacks:
             cb()
+
+    def seal_native(
+        self, object_id: ObjectID, size: int, nested_refs: list | None = None
+    ) -> bool:
+        """Adopt an object a worker process already wrote+sealed in the shared
+        shm store: pin it owner-side and mark the table entry sealed without
+        re-serializing (process-isolation return path). Returns False if the
+        object is not actually resident in shm."""
+        if self._native is None:
+            return False
+        if not self._native.pin(object_id):
+            return False
+        fire = False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            if entry.sealed:
+                # Idempotent reseal on task retry: keep the first copy, drop
+                # the extra pin we just took.
+                self._native.release(object_id)
+                return True
+            entry.value = None
+            entry.size = 0  # shm bytes are accounted by the shm store
+            entry.sealed = True
+            entry.freed = False
+            entry.in_native = True
+            entry.nested_refs = nested_refs
+            entry.last_access = time.monotonic()
+            entry.event.set()
+            callbacks, entry.callbacks = entry.callbacks, []
+            fire = True
+        if fire:
+            for cb in callbacks:
+                cb()
+        return True
+
+    def is_native(self, object_id: ObjectID) -> bool:
+        """True if the sealed object's bytes live in the shared shm store."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.sealed and entry.in_native
 
     def on_sealed(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         """Invoke `callback` once the object is sealed (immediately if already).
@@ -165,13 +251,25 @@ class InProcessStore:
             entry.last_access = time.monotonic()
             spilled_uri = entry.spilled_uri
             if spilled_uri is None and not entry.in_native:
-                return entry.value
+                value = entry.value
+                if not isinstance(value, _Pickled):
+                    return value
+        if spilled_uri is None and not entry.in_native:
+            # Deserialize outside the lock: a fresh copy per reader.
+            import cloudpickle
+
+            return cloudpickle.loads(value.data)
         if spilled_uri is not None:
             # Restore from disk outside the lock. The value is returned
             # without re-admitting it to the in-memory table (reads hit disk
             # until memory pressure clears and a reseal happens naturally).
             try:
-                return self._spill.restore(spilled_uri)
+                restored = self._spill.restore(spilled_uri)
+                if isinstance(restored, _Pickled):
+                    import cloudpickle
+
+                    return cloudpickle.loads(restored.data)
+                return restored
             except FileNotFoundError:
                 # Raced with free()/delete() unlinking the spill file.
                 raise ObjectFreedError(
@@ -231,10 +329,14 @@ class InProcessStore:
     def delete(self, object_ids: Iterable[ObjectID]) -> None:
         natives = []
         spilled = []
+        dropped = []  # keeps popped entries alive until the lock is released
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.pop(oid, None)
-                if entry is not None and entry.sealed:
+                if entry is None:
+                    continue
+                dropped.append(entry)
+                if entry.sealed:
                     if entry.spilled_uri is not None:
                         spilled.append(entry.spilled_uri)
                     else:
@@ -251,6 +353,7 @@ class InProcessStore:
         fired: list[Callable[[], None]] = []
         natives = []
         spilled = []
+        dropped = []  # nested-ref lists die outside the lock
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
@@ -264,8 +367,13 @@ class InProcessStore:
                     if entry.in_native:
                         natives.append(oid)
                         entry.in_native = False
+                    # Park both the live value and the nested refs: either may
+                    # hold the last ObjectRef handle to another object, whose
+                    # __del__ re-enters this store via the refcounter.
+                    dropped.append((entry.value, entry.nested_refs))
                     entry.value = None
                     entry.freed = True
+                    entry.nested_refs = None
                     entry.event.set()
                     fired.extend(entry.callbacks)
                     entry.callbacks = []
@@ -299,7 +407,7 @@ class InProcessStore:
             )
         return entry
 
-    def _evict_locked(self, need_bytes: int) -> None:
+    def _evict_locked(self, need_bytes: int, dropped: list) -> None:
         """LRU eviction of sealed, unpinned objects (plasma eviction_policy.h);
         when everything left is referenced, primary copies spill to external
         storage instead of failing (local_object_manager.h SpillObjects) —
@@ -311,6 +419,7 @@ class InProcessStore:
                 if entry.sealed
                 and not entry.freed
                 and entry.spilled_uri is None  # spilled: no resident bytes
+                and not entry.in_native  # shm bytes: governed by shm's own LRU
                 and not self._pinned_check(oid)
             ),
             key=lambda item: item[0],
@@ -326,6 +435,7 @@ class InProcessStore:
                 # mutex, no re-entry into this store.
                 self._native.unpin_and_delete(oid)
                 entry.in_native = False
+            dropped.append((entry, entry.value))  # value destructs off-lock
             entry.value = None
             entry.freed = True
             entry.event.set()
@@ -350,6 +460,7 @@ class InProcessStore:
                 # Spill IO under the lock: correctness over concurrency for
                 # the pressure path (the reference offloads to IO workers).
                 entry.spilled_uri = self._spill.spill(oid, entry.value)
+                dropped.append(entry.value)  # live value destructs off-lock
                 entry.value = None
                 reclaimed += entry.size
                 self._used -= entry.size

@@ -87,7 +87,8 @@ class ReferenceCounter:
             if ref is None:
                 return
             ref.local_ref_count = max(0, ref.local_ref_count - 1)
-            self._maybe_collect(object_id, ref)
+            actions = self._maybe_collect(object_id, ref)
+        self._run_collect_actions(actions)
 
     # -- task arg lifecycle (Update{Submitted,Finished}TaskReferences) -------
 
@@ -97,13 +98,15 @@ class ReferenceCounter:
                 self._refs.setdefault(oid, _Ref()).submitted_count += 1
 
     def update_finished_task_references(self, arg_ids: list[ObjectID]) -> None:
+        all_actions = []
         with self._lock:
             for oid in arg_ids:
                 ref = self._refs.get(oid)
                 if ref is None:
                     continue
                 ref.submitted_count = max(0, ref.submitted_count - 1)
-                self._maybe_collect(oid, ref)
+                all_actions.extend(self._maybe_collect(oid, ref))
+        self._run_collect_actions(all_actions)
 
     # -- borrowing -----------------------------------------------------------
     # Serializing a ref inside task args/returns makes the receiver a borrower
@@ -151,11 +154,15 @@ class ReferenceCounter:
 
     # -- internals -----------------------------------------------------------
 
-    def _maybe_collect(self, object_id: ObjectID, ref: _Ref) -> None:
-        """Caller must hold the lock."""
+    def _maybe_collect(self, object_id: ObjectID, ref: _Ref) -> list:
+        """Caller must hold the lock. Returns deferred callback actions —
+        the callbacks re-enter the store (delete) and can cascade into more
+        refcount calls, so they must run OUTSIDE the lock to keep the
+        refcount-lock/store-lock ordering acyclic."""
         if not ref.out_of_scope:
-            return
+            return []
         del self._refs[object_id]
+        actions: list = []
         owner_task = ref.owner_task
         if owner_task is not None:
             outputs = self._task_outputs.get(owner_task)
@@ -163,5 +170,13 @@ class ReferenceCounter:
                 outputs.discard(object_id)
                 if not outputs:
                     del self._task_outputs[owner_task]
-                    self._on_lineage_released(owner_task)
-        self._on_out_of_scope(object_id)
+                    actions.append(("lineage", owner_task))
+        actions.append(("oos", object_id))
+        return actions
+
+    def _run_collect_actions(self, actions: list) -> None:
+        for kind, arg in actions:
+            if kind == "oos":
+                self._on_out_of_scope(arg)
+            else:
+                self._on_lineage_released(arg)

@@ -167,6 +167,7 @@ class Runtime:
             native=self._native_store,
             native_threshold=self.config.native_store_threshold,
             spill_storage=self._spill_storage,
+            serialize=self.config.serialize_objects,
         )
         self.refcount = ReferenceCounter(
             on_object_out_of_scope=lambda oid: self.store.delete([oid]),
@@ -209,7 +210,12 @@ class Runtime:
         is_head: bool = False,
     ) -> NodeID:
         node = NodeState(NodeID.from_random(), resources, labels)
-        engine = NodeEngine(node, on_task_done=self._on_task_done)
+        if self.config.isolation == "process":
+            from ray_tpu._private.process_engine import ProcessNodeEngine
+
+            engine = ProcessNodeEngine(node, self, on_task_done=self._on_task_done)
+        else:
+            engine = NodeEngine(node, on_task_done=self._on_task_done)
         with self._lock:
             self.engines[node.node_id] = engine
         self.controller.register_node(node, is_head=is_head)
@@ -647,6 +653,26 @@ class Runtime:
         )
         self.scheduler.notify()
 
+    def on_actor_process_died(self, actor_id: ActorID, reason: str) -> None:
+        """An actor's worker process died out from under us (crash, os._exit,
+        OOM-kill). Release its slot and restart per max_restarts — the
+        process-isolation analog of GcsActorManager::OnWorkerDead
+        (gcs_actor_manager.cc:1036)."""
+        with self._lock:
+            executor = self.actor_executors.pop(actor_id, None)
+            node_grant = self._actor_grants.pop(actor_id, None)
+        if executor is not None:
+            if hasattr(executor, "mark_dead"):
+                executor.mark_dead(reason)
+            executor.node.remove_actor(actor_id)
+        if node_grant is not None:
+            node_id, grant = node_grant
+            node = self.controller.nodes.get(node_id)
+            if node is not None:
+                node.release(grant)
+        self._handle_actor_death(actor_id, reason, allow_restart=True)
+        self.scheduler.notify()
+
     def _handle_actor_death(
         self, actor_id: ActorID, reason: str, allow_restart: bool
     ) -> None:
@@ -780,7 +806,11 @@ class Runtime:
         self.scheduler.notify()
 
     def _maybe_retry(self, spec: TaskSpec, result: TaskResult) -> bool:
-        system_failure = isinstance(result.exc, (ActorDiedError, ObjectLostError))
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        system_failure = isinstance(
+            result.exc, (ActorDiedError, ObjectLostError, WorkerCrashedError)
+        )
         with self._lock:
             record = self._task_records.get(spec.task_id)
             if record is None:
@@ -859,8 +889,19 @@ class Runtime:
                     self.store.seal(oid, error)
                 return
             if result.exc is not None:
+                from ray_tpu.exceptions import WorkerCrashedError
+
                 exc = result.exc
-                if not isinstance(exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)):
+                if not isinstance(
+                    exc,
+                    (
+                        TaskError,
+                        ActorDiedError,
+                        ObjectLostError,
+                        TaskCancelledError,
+                        WorkerCrashedError,
+                    ),
+                ):
                     exc = TaskError(exc, result.traceback_str, spec.name)
                 error = ErrorObject(exc, result.traceback_str)
                 for oid in spec.return_ids:
@@ -883,6 +924,10 @@ class Runtime:
                 self._finish_stream(spec, result)
 
     def _seal_returns(self, spec: TaskSpec, value: Any) -> None:
+        from ray_tpu._private.engine import SEALED_EXTERNALLY
+
+        if value is SEALED_EXTERNALLY:
+            return  # worker already sealed the bytes into the shared store
         n = spec.num_returns
         if n == 0:
             return

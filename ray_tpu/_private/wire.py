@@ -1,0 +1,111 @@
+"""Wire protocol for the driver <-> worker-process boundary.
+
+The L0 protocol layer of this framework (reference: src/ray/protobuf/ +
+gRPC in src/ray/rpc/). The reference speaks protobuf over gRPC between
+daemons; here the boundary is driver <-> node-local worker processes over an
+inherited unix socketpair, so the protocol is length-prefixed cloudpickle
+frames — same framing both directions, full duplex, strictly ordered per
+socket (ordering is load-bearing: incref frames must land before the task's
+"done", and stream items before the stream's completion).
+
+Frame = [u32 little-endian length][cloudpickle payload].
+Payload = (kind: str, body: dict).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+import cloudpickle
+
+_LEN = struct.Struct("<I")
+
+# Driver -> worker kinds: hello, run_task, create_actor, actor_call, kill,
+#                         rpc_reply
+# Worker -> driver kinds: ready, done, stream_item, rpc, incref, decref
+
+
+class Connection:
+    """One framed, thread-safe duplex connection over a stream socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_buf = b""
+
+    def send(self, kind: str, body: dict) -> None:
+        self.send_bytes(cloudpickle.dumps((kind, body), protocol=5))
+
+    def send_bytes(self, payload: bytes) -> None:
+        """Ship an already-serialized frame (lets callers distinguish
+        serialization errors from socket errors)."""
+        frame = _LEN.pack(len(payload)) + payload
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def recv(self) -> Optional[tuple[str, dict]]:
+        """Blocking read of one frame; None on clean EOF/reset."""
+        header = self._recv_exact(_LEN.size)
+        if header is None:
+            return None
+        (length,) = _LEN.unpack(header)
+        payload = self._recv_exact(length)
+        if payload is None:
+            return None
+        return cloudpickle.loads(payload)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = self._recv_buf
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(min(1 << 20, max(4096, n - len(buf))))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        self._recv_buf = buf[n:]
+        return buf[:n]
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class WireRef:
+    """Placeholder for a resolved top-level ObjectRef argument.
+
+    The driver's dependency resolver guarantees the object is sealed before
+    dispatch; the worker materializes it either zero-copy from the shared
+    shm store (in_native) or via a get_by_id RPC to the owner.
+    """
+
+    __slots__ = ("oid_bytes", "in_native")
+
+    def __init__(self, oid_bytes: bytes, in_native: bool):
+        self.oid_bytes = oid_bytes
+        self.in_native = in_native
+
+
+def send_with_fallback(
+    conn: Connection, kind: str, body: dict, fallback: dict
+) -> None:
+    """Send a frame whose body may fail to pickle (user values/exceptions);
+    degrade to the picklable `fallback` body, and swallow socket errors —
+    a dead peer is detected by the reader, not the writer."""
+    try:
+        conn.send(kind, body)
+    except Exception:
+        try:
+            conn.send(kind, fallback)
+        except Exception:
+            pass

@@ -1,0 +1,702 @@
+"""Worker process entry point (process-isolation mode).
+
+The analog of the reference's `python/ray/_private/workers/default_worker.py`
+plus the worker half of CoreWorker: a standalone process that executes tasks
+and hosts at most one actor, speaking the wire protocol (wire.py) to the
+driver over an inherited socketpair fd.
+
+Fate-sharing: the socket IS the lifeline. EOF in either direction means the
+peer died; the worker exits immediately (reference: raylet socket
+disconnect -> worker suicide, core_worker.cc OnRayletDisconnected) and the
+driver fails the worker's in-flight tasks.
+
+Inside tasks the full `ray_tpu` public API works: a `WorkerProxyRuntime` is
+installed as the process-global runtime, forwarding put/get/wait/submit/actor
+calls to the owning driver as RPC frames (the worker->owner leg of the
+reference's CoreWorkerService).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import queue
+import socket
+import sys
+import threading
+import traceback
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu._private import wire
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.task_spec import TaskKind, TaskSpec
+
+_SIZE_PROBE_LIMIT = 64  # list/tuple/dict items sampled when sizing values
+
+
+def _approx_size(value: Any) -> int:
+    """Cheap size probe deciding socket-vs-shm for return values."""
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return value.nbytes
+    except ImportError:
+        pass
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, (list, tuple)) and value:
+        sample = value[:_SIZE_PROBE_LIMIT]
+        return len(value) * max(1, sum(_approx_size(v) for v in sample) // len(sample))
+    return sys.getsizeof(value)
+
+
+class _BorrowCounter:
+    """Worker-local reference counts; edge transitions notify the owner.
+
+    0->1 sends incref, 1->0 sends decref — so the driver tracks at most one
+    borrow per (worker, object), released on worker death (the in-process
+    analog of the reference's borrower protocol, reference_count.h:39).
+    RPC replies that hand out refs arrive pre-borrowed by the driver to close
+    the race between the reply and this worker's first incref.
+    """
+
+    def __init__(self, proxy: "WorkerProxyRuntime"):
+        self._proxy = proxy
+        self._lock = threading.Lock()
+        self._counts: dict[ObjectID, int] = {}
+        self._preborrowed: set[bytes] = set()
+
+    def note_preborrowed(self, oid_bytes: bytes) -> None:
+        with self._lock:
+            self._preborrowed.add(oid_bytes)
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        send = False
+        with self._lock:
+            n = self._counts.get(object_id, 0)
+            self._counts[object_id] = n + 1
+            if n == 0:
+                if object_id.binary() in self._preborrowed:
+                    self._preborrowed.discard(object_id.binary())
+                else:
+                    send = True
+        if send:
+            self._proxy._send_quiet("incref", {"oid": object_id.binary()})
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        send = False
+        with self._lock:
+            n = self._counts.get(object_id, 0)
+            if n <= 1:
+                self._counts.pop(object_id, None)
+                send = n == 1
+            else:
+                self._counts[object_id] = n - 1
+        if send:
+            self._proxy._send_quiet("decref", {"oid": object_id.binary()})
+
+    # The public-API surface ObjectRef construction may touch:
+    def add_borrowed_reference(self, object_id: ObjectID) -> None:
+        self.add_local_reference(object_id)
+
+
+class _ProxyStoreShim:
+    """Just enough of the store interface for ObjectRef.future()/__await__."""
+
+    def __init__(self, proxy: "WorkerProxyRuntime"):
+        self._proxy = proxy
+
+    def on_sealed(self, object_id: ObjectID, callback) -> None:
+        def waiter():
+            try:
+                self._proxy.rpc("wait_ids", {"oids": [object_id.binary()]})
+            except Exception:
+                pass
+            callback()
+
+        self._proxy.background(waiter)
+
+
+class _ProxyControllerShim:
+    def __init__(self, proxy: "WorkerProxyRuntime"):
+        self._proxy = proxy
+
+    def get_named_actor(self, name: str, namespace: str):
+        info = self._proxy.rpc(
+            "named_actor", {"name": name, "namespace": namespace}
+        )
+        return ActorID(info["actor_id"]) if info else None
+
+    def get_actor_record(self, actor_id: ActorID):
+        info = self._proxy.rpc("actor_record", {"actor_id": actor_id.binary()})
+        if info is None:
+            return None
+
+        class _Rec:
+            pass
+
+        rec = _Rec()
+        for k, v in info.items():
+            setattr(rec, k, v)
+        return rec
+
+
+class _NoopTaskEvents:
+    def record(self, *args, **kwargs) -> None:
+        pass
+
+
+class WorkerProxyRuntime:
+    """Runtime facade inside a worker process: every ownership-bearing
+    operation is an RPC to the driver (the owner); reads of shm-resident
+    objects go zero-copy through the shared native store."""
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self.shutting_down = False
+        self.refcount = _BorrowCounter(self)
+        self.store = _ProxyStoreShim(self)
+        self.controller = _ProxyControllerShim(self)
+        self.task_events = _NoopTaskEvents()
+        from ray_tpu._private.runtime_env import RuntimeEnvManager
+
+        self.runtime_env_manager = RuntimeEnvManager()
+        self.namespace = worker.namespace
+        self.job_id = worker.job_id
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._bg = ThreadPoolExecutor(max_workers=4, thread_name_prefix="wproxy-bg")
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send_quiet(self, kind: str, body: dict) -> None:
+        try:
+            self._worker.conn.send(kind, body)
+        except Exception:
+            pass  # driver gone; we exit when the recv loop sees EOF
+
+    def rpc(self, method: str, payload: dict):
+        return self._worker.rpc(method, payload)
+
+    def background(self, fn) -> None:
+        self._bg.submit(fn)
+
+    def current_task_id(self) -> TaskID:
+        from ray_tpu._private.engine import CONTEXT
+
+        return CONTEXT.task_id or self._worker.driver_task_id
+
+    def _refs_from_reply(self, oid_bytes_list: list) -> list:
+        from ray_tpu._private.object_ref import ObjectRef
+
+        refs = []
+        for raw in oid_bytes_list:
+            self.refcount.note_preborrowed(raw)
+            refs.append(ObjectRef(ObjectID(raw)))
+        return refs
+
+    # -- core API ----------------------------------------------------------
+
+    def put(self, value: Any):
+        reply = self.rpc("put", {"value": value})
+        return self._refs_from_reply([reply["oid"]])[0]
+
+    def get(self, refs: list, timeout: Optional[float]) -> list[Any]:
+        return [self._get_one(ref.id, timeout) for ref in refs]
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        native = self._worker.native
+        if native is not None:
+            found, value = native.get_object(oid)
+            if found:
+                return value
+        # Without a local shm attach, ask the owner for the bytes outright.
+        reply = self.rpc(
+            "get_by_id",
+            {"oid": oid.binary(), "timeout": timeout, "force_value": native is None},
+        )
+        if reply.get("in_native"):
+            found, value = native.get_object(oid)
+            if found:
+                return value
+            reply = self.rpc(
+                "get_by_id", {"oid": oid.binary(), "timeout": timeout, "force_value": True}
+            )
+        return reply["value"]
+
+    def wait(self, refs: list, num_returns: int, timeout: Optional[float]):
+        by_id = {ref.id.binary(): ref for ref in refs}
+        reply = self.rpc(
+            "wait_ids",
+            {
+                "oids": [r.id.binary() for r in refs],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+        )
+        ready = [by_id[raw] for raw in reply["ready"]]
+        remaining = [by_id[raw] for raw in reply["remaining"]]
+        return ready, remaining
+
+    def submit_task(self, func, args, kwargs, **options):
+        reply = self.rpc(
+            "submit_task",
+            {
+                "func": cloudpickle.dumps(func, protocol=5),
+                "args": args,
+                "kwargs": kwargs,
+                "options": options,
+                "parent_task_id": self.current_task_id().binary(),
+            },
+        )
+        refs = self._refs_from_reply(reply["refs"])
+        if reply.get("streaming"):
+            return [self._remote_stream(reply, refs[0])]
+        return refs
+
+    def create_actor(self, cls, args, kwargs, **options):
+        reply = self.rpc(
+            "create_actor",
+            {
+                "cls": cloudpickle.dumps(cls, protocol=5),
+                "args": args,
+                "kwargs": kwargs,
+                "options": options,
+            },
+        )
+        ref = self._refs_from_reply([reply["creation_ref"]])[0]
+        return ActorID(reply["actor_id"]), ref
+
+    def submit_actor_task(self, actor_id: ActorID, method_name, args, kwargs, **options):
+        reply = self.rpc(
+            "submit_actor_task",
+            {
+                "actor_id": actor_id.binary(),
+                "method_name": method_name,
+                "args": args,
+                "kwargs": kwargs,
+                "options": options,
+            },
+        )
+        refs = self._refs_from_reply(reply["refs"])
+        if reply.get("streaming"):
+            return [self._remote_stream(reply, refs[0])]
+        return refs
+
+    def _remote_stream(self, reply: dict, completion_ref):
+        """Consume a streaming task's items from the driver on demand."""
+        from ray_tpu._private.streaming import ObjectRefGenerator, ObjectRefStream
+
+        stream = ObjectRefStream()
+        gen = ObjectRefGenerator(stream, TaskID(reply["task_id"]))
+        gen._completion_ref = completion_ref
+
+        def pump():
+            index = 0
+            while True:
+                try:
+                    item = self.rpc(
+                        "next_stream_item",
+                        {"task_id": reply["task_id"], "index": index},
+                    )
+                except Exception:
+                    stream.finish(index)
+                    return
+                if item["done"]:
+                    stream.finish(item["total"])
+                    return
+                refs = self._refs_from_reply([item["oid"]])
+                stream.offer(refs[0])
+                index += 1
+
+        self.background(pump)
+        return gen
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.rpc("kill_actor", {"actor_id": actor_id.binary(), "no_restart": no_restart})
+
+    def cancel(self, ref, force: bool = False) -> bool:
+        return self.rpc("cancel", {"oid": ref.id.binary(), "force": force})
+
+    def report_stream_item(
+        self, spec: TaskSpec, index: int, value=None, error=None, traceback_str=""
+    ) -> None:
+        body = {"task_id": spec.task_id.binary(), "index": index, "tb": traceback_str}
+        if error is not None:
+            wire.send_with_fallback(
+                self._worker.conn,
+                "stream_item",
+                {**body, "error": error},
+                {**body, "error": RuntimeError(f"unserializable error: {error!r}")},
+            )
+        else:
+            wire.send_with_fallback(
+                self._worker.conn,
+                "stream_item",
+                {**body, "value": value},
+                {**body, "error": RuntimeError(f"unserializable item: {value!r}")},
+            )
+
+
+class Worker:
+    """The worker process: recv loop + task executor."""
+
+    def __init__(self, conn: wire.Connection, hello: dict):
+        self.conn = conn
+        self.node_id = hello["node_id"]
+        self.job_id = JobID(hello["job_id"])
+        self.driver_task_id = TaskID(hello["driver_task_id"])
+        self.namespace = hello.get("namespace", "default")
+        self.native_threshold = hello.get("native_threshold", 0)
+        self.native = None
+        if hello.get("store_name"):
+            try:
+                from ray_tpu._private import native_store
+
+                if native_store.native_store_available():
+                    self.native = native_store.NativeStore(hello["store_name"])
+            except Exception:
+                self.native = None
+        for path in reversed(hello.get("sys_path", [])):
+            if path and path not in sys.path:
+                sys.path.insert(0, path)
+        self._rpc_counter = 0
+        self._rpc_lock = threading.Lock()
+        self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
+        self._inbox: "queue.Queue[Optional[tuple[str, dict]]]" = queue.Queue()
+        # Actor state (one actor per worker process, like the reference).
+        self.actor_instance: Any = None
+        self.actor_creation: Optional[dict] = None
+        self._actor_pool = None
+        self._actor_loop = None
+        self.proxy = WorkerProxyRuntime(self)
+        from ray_tpu._private import runtime as runtime_mod
+
+        runtime_mod._RUNTIME = self.proxy
+
+    # -- RPC client --------------------------------------------------------
+
+    def rpc(self, method: str, payload: dict):
+        with self._rpc_lock:
+            self._rpc_counter += 1
+            msg_id = self._rpc_counter
+            event = threading.Event()
+            slot: dict = {}
+            self._rpc_waiters[msg_id] = (event, slot)
+        self.conn.send("rpc", {"id": msg_id, "method": method, "payload": payload})
+        event.wait()
+        if slot.get("dead"):
+            raise ConnectionError("driver connection lost")
+        if slot["ok"]:
+            return slot["result"]
+        raise slot["exc"]
+
+    def _fail_all_rpcs(self) -> None:
+        with self._rpc_lock:
+            waiters = list(self._rpc_waiters.values())
+            self._rpc_waiters.clear()
+        for event, slot in waiters:
+            slot["dead"] = True
+            event.set()
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> None:
+        executor = threading.Thread(target=self._executor_main, daemon=True)
+        executor.start()
+        self.conn.send("ready", {"pid": os.getpid()})
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                msg = None  # undecodable frame: treat as a dead driver
+            if msg is None:
+                break  # driver died: fate-share
+            kind, body = msg
+            if kind == "rpc_reply":
+                with self._rpc_lock:
+                    waiter = self._rpc_waiters.pop(body["id"], None)
+                if waiter is not None:
+                    event, slot = waiter
+                    slot.update(body)
+                    event.set()
+            elif kind == "kill":
+                break
+            else:
+                self._inbox.put((kind, body))
+        self._fail_all_rpcs()
+        os._exit(0)
+
+    # -- execution ---------------------------------------------------------
+
+    def _executor_main(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                return
+            kind, body = item
+            if kind == "run_task":
+                self._run_normal(body)
+            elif kind == "create_actor":
+                self._create_actor(body)
+            elif kind == "actor_call":
+                self._dispatch_actor_call(body)
+
+    def _build_spec(self, body: dict) -> TaskSpec:
+        return TaskSpec(
+            task_id=TaskID(body["task_id"]),
+            job_id=self.job_id,
+            name=body["name"],
+            kind=TaskKind(body["kind"]),
+            method_name=body.get("method_name"),
+            num_returns=body.get("num_returns", 1),
+            streaming=body.get("streaming", False),
+            actor_id=ActorID(body["actor_id"]) if body.get("actor_id") else None,
+            max_concurrency=body.get("max_concurrency", 1),
+            runtime_env=body.get("runtime_env"),
+        )
+
+    def _set_context(self, body: dict, spec: TaskSpec) -> None:
+        from ray_tpu._private.engine import CONTEXT
+
+        CONTEXT.task_id = spec.task_id
+        CONTEXT.job_id = self.job_id
+        CONTEXT.node_id = self.node_id
+        CONTEXT.actor_id = spec.actor_id
+        CONTEXT.task_name = spec.name
+        CONTEXT.resource_grant = body.get("grant", {})
+        CONTEXT.put_counter = 0
+
+    def _resolve(self, body: dict) -> tuple[tuple, dict]:
+        def materialize(value):
+            if isinstance(value, wire.WireRef):
+                return self.proxy._get_one(ObjectID(value.oid_bytes), timeout=None)
+            return value
+
+        args = tuple(materialize(a) for a in body.get("args", ()))
+        kwargs = {k: materialize(v) for k, v in body.get("kwargs", {}).items()}
+        return args, kwargs
+
+    def _send_done(self, spec: TaskSpec, result) -> None:
+        body = {
+            "task_id": spec.task_id.binary(),
+            "cancelled": result.cancelled,
+            "tb": result.traceback_str,
+        }
+        if result.exc is not None:
+            wire.send_with_fallback(
+                self.conn,
+                "done",
+                {**body, "ok": False, "exc": result.exc},
+                {
+                    **body,
+                    "ok": False,
+                    "exc": RuntimeError(f"unserializable exception: {result.exc!r}"),
+                },
+            )
+            return
+        value = result.value
+        # Large single returns go through shm: the driver seals the existing
+        # allocation instead of copying bytes over the socket. ObjectRefs
+        # serialized into the shm bytes are reported so the driver can pin
+        # them as borrows of the sealed entry (the nested-ref protocol).
+        if (
+            self.native is not None
+            and self.native_threshold
+            and not spec.streaming
+            and spec.num_returns == 1
+            and _approx_size(value) >= self.native_threshold
+        ):
+            try:
+                from ray_tpu._private.object_ref import capture_serialized_refs
+
+                nested: list = []
+                with capture_serialized_refs(nested):
+                    size = self.native.put_object(spec.return_ids[0], value)
+                self.conn.send(
+                    "done",
+                    {
+                        **body,
+                        "ok": True,
+                        "in_native": size,
+                        "nested": [r.id.binary() for r in nested],
+                    },
+                )
+                return
+            except Exception:
+                pass  # shm full or unpicklable: fall through to socket bytes
+        wire.send_with_fallback(
+            self.conn,
+            "done",
+            {**body, "ok": True, "value": value},
+            {
+                **body,
+                "ok": False,
+                "exc": RuntimeError(
+                    f"unserializable return value from {spec.name}"
+                ),
+            },
+        )
+
+    def _run_normal(self, body: dict) -> None:
+        from ray_tpu._private.engine import (
+            _activate_runtime_env,
+            _maybe_consume_stream,
+            _run_callable,
+        )
+
+        spec = self._build_spec(body)
+        spec.compute_return_ids()
+        self._set_context(body, spec)
+        try:
+            func = cloudpickle.loads(body["func"])
+            spec.func = func
+            args, kwargs = self._resolve(body)
+            env_cm = _activate_runtime_env(spec)
+        except BaseException as exc:  # noqa: BLE001 — bad args/env
+            from ray_tpu._private.engine import TaskResult
+
+            self._send_done(
+                spec, TaskResult(exc=exc, traceback_str=traceback.format_exc())
+            )
+            return
+        with env_cm:
+            result = _run_callable(func, args, kwargs)
+            result = _maybe_consume_stream(spec, result)
+        self._send_done(spec, result)
+
+    # -- actor -------------------------------------------------------------
+
+    def _create_actor(self, body: dict) -> None:
+        from ray_tpu._private.engine import (
+            TaskResult,
+            _activate_runtime_env,
+            _run_callable,
+        )
+
+        spec = self._build_spec(body)
+        spec.compute_return_ids()
+        self._set_context(body, spec)
+        self.actor_creation = body
+        try:
+            cls = cloudpickle.loads(body["func"])
+            args, kwargs = self._resolve(body)
+            with _activate_runtime_env(spec):
+                result = _run_callable(lambda *a, **k: cls(*a, **k), args, kwargs)
+            if result.exc is None:
+                self.actor_instance = result.value
+                result = TaskResult(value=None)
+        except BaseException as exc:  # noqa: BLE001
+            result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
+        if result.exc is None:
+            self._setup_actor_concurrency(cls, body.get("max_concurrency", 1))
+        self._send_done(spec, result)
+
+    def _setup_actor_concurrency(self, cls: type, max_concurrency: int) -> None:
+        is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, predicate=inspect.isfunction)
+        )
+        if is_async:
+            import asyncio
+
+            self._actor_sem = asyncio.Semaphore(max(1, max_concurrency))
+            self._actor_loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=self._actor_loop.run_forever, daemon=True
+            )
+            thread.start()
+        elif max_concurrency > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._actor_pool = ThreadPoolExecutor(max_workers=max_concurrency)
+
+    def _dispatch_actor_call(self, body: dict) -> None:
+        if self._actor_loop is not None:
+            import asyncio
+
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_call_async(body), self._actor_loop
+            )
+        elif self._actor_pool is not None:
+            self._actor_pool.submit(self._run_actor_call, body)
+        else:
+            self._run_actor_call(body)
+
+    def _run_actor_call(self, body: dict) -> None:
+        from ray_tpu._private.engine import (
+            TaskResult,
+            _activate_runtime_env,
+            _maybe_consume_stream,
+            _run_callable,
+        )
+
+        spec = self._build_spec(body)
+        spec.compute_return_ids()
+        self._set_context(body, spec)
+        try:
+            args, kwargs = self._resolve(body)
+            method = getattr(self.actor_instance, spec.method_name)
+            fallback_env = (
+                self.actor_creation.get("runtime_env") if self.actor_creation else None
+            )
+            with _activate_runtime_env(spec, fallback=fallback_env):
+                result = _run_callable(method, args, kwargs)
+                result = _maybe_consume_stream(spec, result)
+        except BaseException as exc:  # noqa: BLE001
+            result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
+        self._send_done(spec, result)
+
+    async def _run_actor_call_async(self, body: dict) -> None:
+        async with self._actor_sem:
+            await self._run_actor_call_async_inner(body)
+
+    async def _run_actor_call_async_inner(self, body: dict) -> None:
+        from ray_tpu._private.engine import (
+            TaskResult,
+            _activate_runtime_env,
+            _consume_async_stream,
+            _maybe_consume_stream,
+            _run_callable,
+        )
+
+        spec = self._build_spec(body)
+        spec.compute_return_ids()
+        self._set_context(body, spec)
+        try:
+            args, kwargs = self._resolve(body)
+            method = getattr(self.actor_instance, spec.method_name)
+            fallback_env = (
+                self.actor_creation.get("runtime_env") if self.actor_creation else None
+            )
+            env = _activate_runtime_env(spec, fallback=fallback_env)
+            with env:
+                if inspect.isasyncgenfunction(method) and spec.streaming:
+                    result = await _consume_async_stream(spec, method(*args, **kwargs))
+                elif inspect.iscoroutinefunction(method):
+                    value = await method(*args, **kwargs)
+                    result = _maybe_consume_stream(spec, TaskResult(value=value))
+                else:
+                    result = _run_callable(method, args, kwargs)
+                    result = _maybe_consume_stream(spec, result)
+        except BaseException as exc:  # noqa: BLE001
+            result = TaskResult(exc=exc, traceback_str=traceback.format_exc())
+        self._send_done(spec, result)
+
+
+def main() -> None:
+    fd = int(os.environ["RAY_TPU_WORKER_FD"])
+    sock = socket.socket(fileno=fd)
+    conn = wire.Connection(sock)
+    msg = conn.recv()
+    if msg is None or msg[0] != "hello":
+        os._exit(1)
+    worker = Worker(conn, msg[1])
+    worker.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,12 @@ class TaskCancelledError(RayTpuError):
         super().__init__(f"Task {task_id} was cancelled")
 
 
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly (reference:
+    python/ray/exceptions.py WorkerCrashedError). A system failure: always
+    consumes a retry regardless of retry_exceptions."""
+
+
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id=None, reason: str = "object lost"):
         self.object_id = object_id
