@@ -1,0 +1,354 @@
+"""Core runtime microbenchmarks.
+
+A fresh TPU-native re-implementation of the reference's microbenchmark matrix
+(reference: python/ray/_private/ray_perf.py:93 main(); recorded numbers in
+release/release_logs/2.2.0/microbenchmark.json, mirrored in BASELINE.md).
+Each benchmark prints one JSON line:
+
+    {"benchmark": ..., "value": ..., "unit": "ops/s"|"GB/s",
+     "baseline": <reference m5-class number>, "vs_baseline": ratio}
+
+Run:  python benchmarks/microbenchmark.py [--filter substr] [--json-out PATH]
+Environment: RAY_TPU_ISOLATION=process exercises the process-worker path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import ray_tpu
+
+# Reference numbers from BASELINE.md (m5.16xlarge-class node, Ray 2.2.0).
+BASELINES = {
+    "single_client_tasks_sync": 1294,
+    "single_client_tasks_async": 10905,
+    "multi_client_tasks_async": 32133,
+    "1_1_actor_calls_sync": 2182,
+    "1_1_actor_calls_async": 5770,
+    "1_1_actor_calls_concurrent": 4668,
+    "1_n_actor_calls_async": 11646,
+    "n_n_actor_calls_async": 35152,
+    "n_n_actor_calls_with_arg_async": 2832,
+    "1_1_async_actor_calls_sync": 1479,
+    "1_1_async_actor_calls_async": 2746,
+    "n_n_async_actor_calls_async": 28666,
+    "single_client_put_calls": 5893,
+    "single_client_get_calls": 5877,
+    "multi_client_put_calls": 11141,
+    "single_client_put_gigabytes": 19.2,
+    "multi_client_put_gigabytes": 38.4,
+    "single_client_tasks_and_get_batch": 11.2,
+    "placement_group_create_removal": 1016,
+}
+
+RESULTS: list[dict] = []
+
+
+def report(name: str, value: float, unit: str = "ops/s") -> None:
+    baseline = BASELINES.get(name)
+    row = {
+        "benchmark": name,
+        "value": round(value, 2),
+        "unit": unit,
+        "baseline": baseline,
+        "vs_baseline": round(value / baseline, 3) if baseline else None,
+    }
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def timeit(fn, n_per_call: int = 1, min_seconds: float = 2.0) -> float:
+    """ops/s of fn(), warmed up once, run until min_seconds elapse."""
+    fn()  # warmup
+    calls = 0
+    start = time.perf_counter()
+    while True:
+        fn()
+        calls += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= min_seconds:
+            return calls * n_per_call / elapsed
+
+
+# -- definitions -------------------------------------------------------------
+
+
+@ray_tpu.remote
+def tiny():
+    return b"ok"
+
+
+@ray_tpu.remote
+class Sink:
+    def sink(self, *args):
+        return b"ok"
+
+
+@ray_tpu.remote
+class AsyncSink:
+    async def sink(self, *args):
+        return b"ok"
+
+
+def bench_tasks_sync():
+    report(
+        "single_client_tasks_sync",
+        timeit(lambda: ray_tpu.get(tiny.remote())),
+    )
+
+
+def bench_tasks_async():
+    def batch():
+        ray_tpu.get([tiny.remote() for _ in range(1000)])
+
+    report("single_client_tasks_async", timeit(batch, n_per_call=1000))
+
+
+def bench_multi_client_tasks_async(n_clients: int = 8):
+    pool = ThreadPoolExecutor(max_workers=n_clients)
+
+    def batch():
+        futs = [
+            pool.submit(lambda: ray_tpu.get([tiny.remote() for _ in range(500)]))
+            for _ in range(n_clients)
+        ]
+        for f in futs:
+            f.result()
+
+    report(
+        "multi_client_tasks_async", timeit(batch, n_per_call=500 * n_clients)
+    )
+    pool.shutdown()
+
+
+def bench_actor_calls(name: str, actor_cls, n_actors: int, n_clients: int,
+                      sync: bool, with_arg: bool = False,
+                      options: dict | None = None):
+    actors = [
+        (actor_cls.options(**options) if options else actor_cls).remote()
+        for _ in range(n_actors)
+    ]
+    ray_tpu.get([a.sink.remote() for a in actors])  # ready
+    arg = ray_tpu.put(np.zeros(100 * 1024, dtype=np.uint8)) if with_arg else None
+
+    if sync:
+        def run():
+            for _ in range(100):
+                ray_tpu.get(actors[0].sink.remote())
+
+        report(name, timeit(run, n_per_call=100))
+    elif n_clients == 1:
+        def run():
+            refs = []
+            for _ in range(200):
+                for a in actors:
+                    refs.append(a.sink.remote(arg) if with_arg else a.sink.remote())
+            ray_tpu.get(refs)
+
+        report(name, timeit(run, n_per_call=200 * n_actors))
+    else:
+        pool = ThreadPoolExecutor(max_workers=n_clients)
+
+        def client(a):
+            refs = [
+                (a.sink.remote(arg) if with_arg else a.sink.remote())
+                for _ in range(200)
+            ]
+            ray_tpu.get(refs)
+
+        def run():
+            futs = [pool.submit(client, a) for a in actors for _ in (0,)]
+            for f in futs:
+                f.result()
+
+        report(name, timeit(run, n_per_call=200 * n_actors))
+        pool.shutdown()
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def bench_puts_and_gets():
+    payload = np.zeros(10 * 1024, dtype=np.uint8)  # 10KB, matches reference
+
+    def put_loop():
+        for _ in range(100):
+            ray_tpu.put(payload)
+
+    report("single_client_put_calls", timeit(put_loop, n_per_call=100))
+
+    ref = ray_tpu.put(payload)
+
+    def get_loop():
+        for _ in range(100):
+            ray_tpu.get(ref)
+
+    report("single_client_get_calls", timeit(get_loop, n_per_call=100))
+
+    pool = ThreadPoolExecutor(max_workers=8)
+
+    def multi_put():
+        futs = [pool.submit(put_loop) for _ in range(8)]
+        for f in futs:
+            f.result()
+
+    report("multi_client_put_calls", timeit(multi_put, n_per_call=800))
+    pool.shutdown()
+
+
+def bench_put_gigabytes():
+    chunk = np.random.randint(0, 256, size=(1 << 30) // 8, dtype=np.uint8)  # 128MB
+
+    def put_gb():
+        refs = [ray_tpu.put(chunk) for _ in range(8)]  # 1 GiB total
+        del refs
+
+    gb_per_call = 1.0
+    value = timeit(put_gb, min_seconds=4.0)
+    report("single_client_put_gigabytes", value * gb_per_call, unit="GB/s")
+
+    pool = ThreadPoolExecutor(max_workers=4)
+
+    def multi_put_gb():
+        futs = [
+            pool.submit(lambda: [ray_tpu.put(chunk) for _ in range(2)])
+            for _ in range(4)
+        ]
+        for f in futs:
+            f.result()
+
+    value = timeit(multi_put_gb, min_seconds=4.0)
+    report("multi_client_put_gigabytes", value * gb_per_call, unit="GB/s")
+    pool.shutdown()
+
+
+def bench_tasks_and_get_batch():
+    @ray_tpu.remote
+    def small_value():
+        return b"ok"
+
+    def run():
+        submitted = [small_value.remote() for _ in range(1000)]
+        ray_tpu.get(submitted)
+
+    report("single_client_tasks_and_get_batch", timeit(run, min_seconds=2.0))
+
+
+def bench_placement_groups():
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    def cycle():
+        for _ in range(10):
+            pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+            pg.ready(timeout=5)
+            remove_placement_group(pg)
+
+    report("placement_group_create_removal", timeit(cycle, n_per_call=10))
+
+
+ALL = [
+    ("single_client_tasks_sync", bench_tasks_sync),
+    ("single_client_tasks_async", bench_tasks_async),
+    ("multi_client_tasks_async", bench_multi_client_tasks_async),
+    (
+        "1_1_actor_calls_sync",
+        lambda: bench_actor_calls("1_1_actor_calls_sync", Sink, 1, 1, sync=True),
+    ),
+    (
+        "1_1_actor_calls_async",
+        lambda: bench_actor_calls("1_1_actor_calls_async", Sink, 1, 1, sync=False),
+    ),
+    (
+        "1_1_actor_calls_concurrent",
+        lambda: bench_actor_calls(
+            "1_1_actor_calls_concurrent", Sink, 1, 1, sync=False,
+            options={"max_concurrency": 16},
+        ),
+    ),
+    (
+        "1_n_actor_calls_async",
+        lambda: bench_actor_calls("1_n_actor_calls_async", Sink, 8, 1, sync=False),
+    ),
+    (
+        "n_n_actor_calls_async",
+        lambda: bench_actor_calls("n_n_actor_calls_async", Sink, 8, 8, sync=False),
+    ),
+    (
+        "n_n_actor_calls_with_arg_async",
+        lambda: bench_actor_calls(
+            "n_n_actor_calls_with_arg_async", Sink, 8, 8, sync=False, with_arg=True
+        ),
+    ),
+    (
+        "1_1_async_actor_calls_sync",
+        lambda: bench_actor_calls(
+            "1_1_async_actor_calls_sync", AsyncSink, 1, 1, sync=True
+        ),
+    ),
+    (
+        "1_1_async_actor_calls_async",
+        lambda: bench_actor_calls(
+            "1_1_async_actor_calls_async", AsyncSink, 1, 1, sync=False
+        ),
+    ),
+    (
+        "n_n_async_actor_calls_async",
+        lambda: bench_actor_calls(
+            "n_n_async_actor_calls_async", AsyncSink, 8, 8, sync=False
+        ),
+    ),
+    ("put_get_calls", bench_puts_and_gets),
+    ("put_gigabytes", bench_put_gigabytes),
+    ("tasks_and_get_batch", bench_tasks_and_get_batch),
+    ("placement_group_create_removal", bench_placement_groups),
+]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--filter", default="", help="substring filter")
+    parser.add_argument("--json-out", default="", help="write results to file")
+    args = parser.parse_args()
+
+    ray_tpu.init(num_cpus=16)
+    for name, fn in ALL:
+        if args.filter and args.filter not in name:
+            continue
+        fn()
+    ray_tpu.shutdown()
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(RESULTS, f, indent=2)
+    beat = sum(
+        1 for r in RESULTS if r["vs_baseline"] is not None and r["vs_baseline"] >= 1.0
+    )
+    total = sum(1 for r in RESULTS if r["vs_baseline"] is not None)
+    # Local memory-bandwidth ceiling for honest GB/s comparisons: the
+    # reference numbers come from an m5.16xlarge-class box; put-gigabytes is
+    # a memcpy at heart and cannot exceed this machine's copy bandwidth.
+    a = np.ones(1 << 27, dtype=np.uint8)
+    b = np.empty_like(a)
+    np.copyto(b, a)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.copyto(b, a)
+        best = max(best, a.nbytes / (time.perf_counter() - t0) / 1e9)
+    print(
+        json.dumps(
+            {
+                "benchmark": "summary",
+                "beats_baseline": beat,
+                "compared": total,
+                "local_memcpy_gbps": round(best, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

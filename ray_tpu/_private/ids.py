@@ -13,7 +13,15 @@ ObjectID = TaskID + little-endian 4-byte return/put index.  ActorID for a normal
 from __future__ import annotations
 
 import os
+import random
 import threading
+
+# ID uniqueness needs speed, not cryptographic strength: randbytes (Mersenne
+# Twister) is ~20x faster than os.urandom and the submit path mints two IDs
+# per task. A PRIVATE instance seeded from urandom — never the global random
+# module, which user code re-seeds for reproducibility (random.seed(42) in
+# two tasks would otherwise mint identical ID streams -> object collisions).
+_randbytes = random.Random(os.urandom(16)).randbytes
 
 JOB_ID_SIZE = 4
 ACTOR_UNIQUE_SIZE = 12  # ActorID = unique(12) + JobID(4)
@@ -46,7 +54,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_randbytes(cls.SIZE))
 
     @classmethod
     def nil(cls):
@@ -84,7 +92,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID, unique: bytes | None = None) -> "ActorID":
-        unique = unique if unique is not None else os.urandom(ACTOR_UNIQUE_SIZE)
+        unique = unique if unique is not None else _randbytes(ACTOR_UNIQUE_SIZE)
         return cls(unique + job_id.binary())
 
     @property
@@ -97,7 +105,7 @@ class TaskID(BaseID):
 
     @classmethod
     def of(cls, actor_id: ActorID, unique: bytes | None = None) -> "TaskID":
-        unique = unique if unique is not None else os.urandom(TASK_UNIQUE_SIZE)
+        unique = unique if unique is not None else _randbytes(TASK_UNIQUE_SIZE)
         return cls(unique + actor_id.binary())
 
     @classmethod

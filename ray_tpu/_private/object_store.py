@@ -182,6 +182,54 @@ class InProcessStore:
         for cb in callbacks:
             cb()
 
+    def seal_pickled(
+        self, object_id: ObjectID, data: bytes, nested_refs: list | None = None
+    ) -> None:
+        """Seal a value that is ALREADY serialized (bytes produced by a worker
+        process): stored as _Pickled directly, skipping the driver-side
+        re-serialization that seal() would perform."""
+        dropped: list = []
+        size = len(data)
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            if entry.sealed:
+                return  # idempotent reseal on retry: keep the first copy
+            if self._budget is not None and self._used + size > self._budget:
+                self._evict_locked(self._used + size - self._budget, dropped)
+            entry.value = _Pickled(data)
+            entry.size = size
+            entry.sealed = True
+            entry.freed = False
+            entry.in_native = False
+            entry.nested_refs = nested_refs
+            entry.last_access = time.monotonic()
+            self._used += size
+            entry.event.set()
+            callbacks, entry.callbacks = entry.callbacks, []
+        for cb in callbacks:
+            cb()
+
+    def get_serialized(self, object_id: ObjectID) -> bytes | None:
+        """The sealed value's serialized bytes, if held in-process as
+        _Pickled (None for native/spilled/live-stored values) — lets RPC
+        replies forward bytes without a decode/re-encode round trip."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if (
+                entry is None
+                or not entry.sealed
+                or entry.freed
+                or entry.spilled_uri is not None
+                or entry.in_native
+            ):
+                return None
+            entry.last_access = time.monotonic()
+            value = entry.value
+            return value.data if isinstance(value, _Pickled) else None
+
     def seal_native(
         self, object_id: ObjectID, size: int, nested_refs: list | None = None
     ) -> bool:
@@ -245,16 +293,19 @@ class InProcessStore:
 
     def get(self, object_id: ObjectID, timeout: float | None = None) -> Any:
         entry = self._wait_entry(object_id, timeout)
+        # Decide the read mode ONCE under the lock — entry fields are mutable
+        # and a concurrent free() must not flip the branch mid-read.
         with self._lock:
             if entry.freed:
                 raise ObjectFreedError(object_id, f"Object {object_id} was freed")
             entry.last_access = time.monotonic()
             spilled_uri = entry.spilled_uri
-            if spilled_uri is None and not entry.in_native:
+            in_native = entry.in_native
+            if spilled_uri is None and not in_native:
                 value = entry.value
                 if not isinstance(value, _Pickled):
                     return value
-        if spilled_uri is None and not entry.in_native:
+        if spilled_uri is None and not in_native:
             # Deserialize outside the lock: a fresh copy per reader.
             import cloudpickle
 
@@ -435,8 +486,12 @@ class InProcessStore:
                 # mutex, no re-entry into this store.
                 self._native.unpin_and_delete(oid)
                 entry.in_native = False
-            dropped.append((entry, entry.value))  # value destructs off-lock
+            # Park value AND nested refs off-lock; clearing nested_refs here
+            # matters: an evicted (unreadable) object must not keep pinning
+            # the inner objects its bytes referenced.
+            dropped.append((entry, entry.value, entry.nested_refs))
             entry.value = None
+            entry.nested_refs = None
             entry.freed = True
             entry.event.set()
             del self._entries[oid]

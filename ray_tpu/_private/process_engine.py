@@ -280,6 +280,13 @@ class ProcessWorkerHandle:
                 result = TaskResult(
                     exc=WorkerCrashedError("shm-resident return value lost")
                 )
+        elif "value_pickled" in body:
+            # Worker pre-serialized the single return: seal the bytes as-is.
+            nested = [ObjectRef(ObjectID(raw)) for raw in body.get("nested", ())]
+            self.runtime.store.seal_pickled(
+                spec.return_ids[0], body["value_pickled"], nested_refs=nested or None
+            )
+            result = TaskResult(value=SEALED_EXTERNALLY)
         else:
             result = TaskResult(value=body.get("value"))
         # Return the worker to the pool before completion bookkeeping so a
@@ -333,6 +340,12 @@ class ProcessWorkerHandle:
                     )
                 if runtime.store.is_native(oid):
                     return {"in_native": True}
+                # Forward in-process serialized bytes untouched (no driver-
+                # side decode + frame re-encode); the worker deserializes and
+                # raises ErrorObjects itself.
+                data = runtime.store.get_serialized(oid)
+                if data is not None:
+                    return {"value_pickled": data}
             value = runtime.store.get(oid, timeout)
             from ray_tpu._private.runtime import ErrorObject
 
@@ -541,34 +554,64 @@ class ProcessNodeEngine:
         self._on_task_done = on_task_done
         self.alive = True
         self._lock = threading.Lock()
-        self._idle: list[ProcessWorkerHandle] = []
+        # (handle, idle_since) — LIFO so checkout reuses the warmest worker
+        # and the reaper kills from the cold end.
+        self._idle: list[tuple[ProcessWorkerHandle, float]] = []
         self._workers: set[ProcessWorkerHandle] = set()
         self._actors: dict[ActorID, ProcessActorExecutor] = {}
         self.rpc_pool = ThreadPoolExecutor(
             max_workers=256, thread_name_prefix=f"rpc-{node.node_id.hex()[:6]}"
         )
+        idle_s = runtime.config.idle_worker_killing_time_s
+        if idle_s and idle_s > 0:
+            reaper = threading.Thread(
+                target=self._reap_loop,
+                args=(idle_s,),
+                name=f"reaper-{node.node_id.hex()[:6]}",
+                daemon=True,
+            )
+            reaper.start()
 
     # -- pool --------------------------------------------------------------
 
     def _checkout(self) -> ProcessWorkerHandle:
         with self._lock:
             if self._idle:
-                return self._idle.pop()
+                return self._idle.pop()[0]
         handle = ProcessWorkerHandle(self)
         with self._lock:
             self._workers.add(handle)
         return handle
 
     def checkin(self, handle: ProcessWorkerHandle) -> None:
+        import time
+
         with self._lock:
             if self.alive and handle in self._workers:
-                self._idle.append(handle)
+                self._idle.append((handle, time.monotonic()))
 
     def forget(self, handle: ProcessWorkerHandle) -> None:
         with self._lock:
             self._workers.discard(handle)
-            if handle in self._idle:
-                self._idle.remove(handle)
+            self._idle = [(h, t) for h, t in self._idle if h is not handle]
+
+    def _reap_loop(self, idle_s: float) -> None:
+        """Kill workers idle longer than idle_worker_killing_time_s
+        (reference: worker_pool.cc idle worker killing)."""
+        import time
+
+        interval = min(10.0, max(1.0, idle_s / 4))
+        while self.alive:
+            time.sleep(interval)
+            cutoff = time.monotonic() - idle_s
+            with self._lock:
+                expired = [h for h, t in self._idle if t <= cutoff]
+                if expired:
+                    gone = set(expired)
+                    self._idle = [(h, t) for h, t in self._idle if h not in gone]
+                    self._workers.difference_update(gone)
+            for handle in expired:
+                handle.kill_process()
 
     # -- NodeEngine interface ----------------------------------------------
 

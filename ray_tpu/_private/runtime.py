@@ -797,7 +797,17 @@ class Runtime:
                     actor_record.state = ActorState.ALIVE
             else:
                 with self._lock:
-                    self.actor_executors.pop(spec.actor_id, None)
+                    executor = self.actor_executors.pop(spec.actor_id, None)
+                if executor is not None:
+                    # Tear the executor down fully — in process mode this
+                    # kills the dedicated worker process, which would
+                    # otherwise idle forever (one leaked OS process per
+                    # failed constructor).
+                    try:
+                        executor.kill(reason="constructor failed")
+                        executor.node.remove_actor(spec.actor_id)
+                    except Exception:
+                        pass
                 self._handle_actor_death(
                     spec.actor_id,
                     f"constructor failed: {result.exc!r}",

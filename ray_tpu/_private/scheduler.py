@@ -44,13 +44,35 @@ from ray_tpu.util.scheduling_strategies import (
 
 
 class PendingTask:
-    __slots__ = ("spec", "request", "target_node", "cancelled")
+    __slots__ = ("spec", "request", "target_node", "cancelled", "shape")
 
     def __init__(self, spec: TaskSpec, request: dict[str, float]):
         self.spec = spec
         self.request = request
         self.target_node: Optional[NodeState] = None
         self.cancelled = False
+        self.shape = _shape_key(spec, request)
+
+
+def _shape_key(spec: TaskSpec, request: dict[str, float]):
+    """Scheduling-equivalence key: two pending tasks with the same shape are
+    interchangeable to the placer, so when one fails to place the rest of its
+    shape can be skipped for the pass (the reference queues tasks per
+    SchedulingClass for exactly this reason, cluster_task_manager.h)."""
+    strategy = spec.scheduling_strategy
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        skey = ("affinity", strategy.node_id, strategy.soft)
+    elif isinstance(strategy, PlacementGroupSchedulingStrategy):
+        skey = (
+            "pg",
+            strategy.placement_group.id,
+            strategy.placement_group_bundle_index,
+        )
+    elif strategy == SPREAD:
+        skey = ("spread",)
+    else:
+        skey = ("default",)
+    return (skey, tuple(sorted(request.items())))
 
 
 def resolve_pg_request(
@@ -84,6 +106,7 @@ class Scheduler:
         self._fail_task = fail_task
         self._cond = threading.Condition()
         self._queue: deque[PendingTask] = deque()
+        self._in_pass: list[PendingTask] = []  # tasks drained into the current pass
         self._spread_cursor = 0
         self._running = True
         self.fail_on_infeasible = True
@@ -103,8 +126,10 @@ class Scheduler:
 
     def cancel(self, task_id) -> bool:
         with self._cond:
-            for pending in self._queue:
-                if pending.spec.task_id == task_id:
+            # The current pass's drained batch is still cancellable: the loop
+            # re-checks pending.cancelled right before dispatching each task.
+            for pending in list(self._queue) + self._in_pass:
+                if pending.spec.task_id == task_id and not pending.cancelled:
                     pending.cancelled = True
                     self._cond.notify_all()
                     return True
@@ -144,36 +169,48 @@ class Scheduler:
                     self._cond.wait()
                 if not self._running:
                     return
+                # Drain the whole queue: dispatched/failed tasks simply don't
+                # come back; unplaced ones are re-queued at the front. Keeps
+                # the loop O(queue) per pass instead of O(queue^2) (the
+                # 1M-queued-tasks envelope, BASELINE.md single-node table).
                 batch = list(self._queue)
-            progressed = self._schedule_batch(batch)
-            # Drop the frame's reference to dispatched specs — the loop parks in
-            # cond.wait() and anything still bound here would never be GC'd.
-            batch.clear()
+                self._queue.clear()
+                self._in_pass = batch
+            leftovers, progressed = self._schedule_batch(batch)
+            batch = []
             with self._cond:
+                self._in_pass = []
+                if leftovers:
+                    self._queue.extendleft(reversed(leftovers))
                 if not progressed and self._queue and self._running:
                     # Nothing placeable right now; wait for a resource change.
                     self._cond.wait(timeout=0.2)
 
-    def _schedule_batch(self, batch: list[PendingTask]) -> bool:
+    def _schedule_batch(
+        self, batch: list[PendingTask]
+    ) -> tuple[list[PendingTask], bool]:
+        """Returns (unplaced tasks to requeue, whether any task progressed)."""
         progressed = False
+        leftovers: list[PendingTask] = []
+        blocked_shapes: set = set()
         for pending in batch:
             if pending.cancelled:
-                self._remove(pending)
                 progressed = True
+                continue
+            if pending.shape in blocked_shapes:
+                leftovers.append(pending)
                 continue
             try:
                 request, pg_record = resolve_pg_request(
                     pending.spec, pending.request, self._controller
                 )
             except PlacementGroupError as exc:
-                self._remove(pending)
                 self._fail_task(pending.spec, exc)
                 progressed = True
                 continue
             try:
                 node = self._pick_node(pending.spec, request)
             except OutOfResourcesError as exc:
-                self._remove(pending)
                 self._fail_task(pending.spec, exc)
                 progressed = True
                 continue
@@ -182,7 +219,6 @@ class Scheduler:
                     pg_record is None or pg_record.state == PlacementGroupState.CREATED
                 ):
                     if self.fail_on_infeasible and not self._demand_listeners:
-                        self._remove(pending)
                         self._fail_task(
                             pending.spec,
                             OutOfResourcesError(
@@ -191,22 +227,19 @@ class Scheduler:
                             ),
                         )
                         progressed = True
-                    else:
-                        for fn in self._demand_listeners:
-                            fn(request)
+                        continue
+                    for fn in self._demand_listeners:
+                        fn(request)
+                blocked_shapes.add(pending.shape)
+                leftovers.append(pending)
                 continue
             if node.allocate(request):
-                self._remove(pending)
                 progressed = True
                 self._dispatch(pending.spec, node, request)
-        return progressed
-
-    def _remove(self, pending: PendingTask) -> None:
-        with self._cond:
-            try:
-                self._queue.remove(pending)
-            except ValueError:
-                pass
+            else:
+                blocked_shapes.add(pending.shape)
+                leftovers.append(pending)
+        return leftovers, progressed
 
     # -- policies -----------------------------------------------------------
 

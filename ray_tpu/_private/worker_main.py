@@ -225,7 +225,15 @@ class WorkerProxyRuntime:
             reply = self.rpc(
                 "get_by_id", {"oid": oid.binary(), "timeout": timeout, "force_value": True}
             )
-        return reply["value"]
+        if "value_pickled" in reply:
+            value = cloudpickle.loads(reply["value_pickled"])
+        else:
+            value = reply["value"]
+        from ray_tpu._private.runtime import ErrorObject
+
+        if isinstance(value, ErrorObject):
+            value.raise_()
+        return value
 
     def wait(self, refs: list, num_returns: int, timeout: Optional[float]):
         by_id = {ref.id.binary(): ref for ref in refs}
@@ -528,6 +536,37 @@ class Worker:
                 return
             except Exception:
                 pass  # shm full or unpicklable: fall through to socket bytes
+        # Single returns ship pre-serialized so the driver can seal the bytes
+        # directly (its store holds values serialized anyway) — one pickle
+        # pass end-to-end instead of pickle/unpickle/pickle.
+        if not spec.streaming and spec.num_returns == 1:
+            try:
+                from ray_tpu._private.object_ref import capture_serialized_refs
+
+                nested = []
+                with capture_serialized_refs(nested):
+                    data = cloudpickle.dumps(value, protocol=5)
+                self.conn.send(
+                    "done",
+                    {
+                        **body,
+                        "ok": True,
+                        "value_pickled": data,
+                        "nested": [r.id.binary() for r in nested],
+                    },
+                )
+            except Exception:
+                self.proxy._send_quiet(
+                    "done",
+                    {
+                        **body,
+                        "ok": False,
+                        "exc": RuntimeError(
+                            f"unserializable return value from {spec.name}"
+                        ),
+                    },
+                )
+            return
         wire.send_with_fallback(
             self.conn,
             "done",
