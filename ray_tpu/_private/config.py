@@ -42,8 +42,19 @@ class Config:
     # Fault tolerance
     task_max_retries: int = 3
     actor_max_restarts: int = 0
-    health_check_period_s: float = 1.0
-    health_check_failure_threshold: int = 5
+    # Active worker-process health probing (ping/pong over the wire): a
+    # worker that fails to pong within period*threshold is declared hung and
+    # killed, driving the normal crash/restart path (reference:
+    # gcs_health_check_manager.h:39, flags ray_config_def.h:784-790).
+    # Default deadline = 3s * 10 = 30s of silence: generous enough that a
+    # long GIL-holding native call (giant pickle, XLA compile) is not
+    # misdiagnosed as a hang.
+    health_check_period_s: float = 3.0
+    health_check_failure_threshold: int = 10
+    # Control-plane persistence: when set, KV/job-counter/detached-actor/PG
+    # tables are snapshotted here and restored by the next session
+    # (reference: gcs_table_storage.h + the Redis `gcs_storage` backend).
+    gcs_storage_path: str = ""
     # Copy (serialize/deserialize) task args even in the in-process engine so
     # mutation bugs surface in tests; direct zero-copy handoff when False.
     inproc_copy_args: bool = False

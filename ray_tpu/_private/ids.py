@@ -21,7 +21,17 @@ import threading
 # per task. A PRIVATE instance seeded from urandom — never the global random
 # module, which user code re-seeds for reproducibility (random.seed(42) in
 # two tasks would otherwise mint identical ID streams -> object collisions).
-_randbytes = random.Random(os.urandom(16)).randbytes
+# Re-seeded after fork: a forked child inheriting the parent's PRNG state
+# would mint the parent's exact ID stream (os.urandom had no such hazard).
+_rand = random.Random(os.urandom(16))
+
+
+def _randbytes(n: int) -> bytes:
+    return _rand.randbytes(n)
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=lambda: _rand.seed(os.urandom(16)))
 
 JOB_ID_SIZE = 4
 ACTOR_UNIQUE_SIZE = 12  # ActorID = unique(12) + JobID(4)

@@ -93,6 +93,10 @@ def _load_lib():
         lib.tps_num_objects.argtypes = [P]
         lib.tps_close.restype = None
         lib.tps_close.argtypes = [P]
+        lib.tps_debug_lock.restype = I
+        lib.tps_debug_lock.argtypes = [P]
+        lib.tps_poisoned.restype = I
+        lib.tps_poisoned.argtypes = [P]
         lib.tps_destroy.restype = I
         lib.tps_destroy.argtypes = [CP]
         _lib = lib
@@ -124,10 +128,6 @@ class NativeStore:
         if not self._handle:
             raise RuntimeError(f"tps_open({name!r}) failed")
         self._lock = threading.Lock()
-        # Objects the owner deleted while reader views still pinned them;
-        # the last reader's finalizer completes the delete (plasma defers
-        # reclamation the same way: eviction waits for client releases).
-        self._deferred_deletes: set = set()
 
     # -- raw bytes API ----------------------------------------------------
 
@@ -142,6 +142,10 @@ class NativeStore:
             raise NativeStoreFullError(f"native store full putting {object_id}")
         if rc == -3:
             raise NativeStoreFullError("native store index full")
+        if rc in (-4, -5):
+            # Poisoned segment / old payload awaiting deferred delete: degrade
+            # to the in-process store (MemoryError is the fallback signal).
+            raise NativeStoreFullError("native store unavailable")
         if rc not in (0, -1):  # -1 = already present (idempotent reseal)
             raise RuntimeError(f"tps_put failed rc={rc}")
 
@@ -166,13 +170,11 @@ class NativeStore:
         return memoryview(array_t).cast("B")
 
     def _release_and_reap(self, key: bytes) -> None:
+        # The deferred-delete decision lives in the shared slot
+        # (delete_pending): tps_release from ANY process reclaims the object
+        # on the last unpin, so the finalizer only needs to release.
         try:
             self._lib.tps_release(self._handle, key)
-            with self._lock:
-                deferred = key in self._deferred_deletes
-            if deferred and self._lib.tps_delete(self._handle, key) == 0:
-                with self._lock:
-                    self._deferred_deletes.discard(key)
         except Exception:
             pass  # interpreter shutdown
 
@@ -189,14 +191,12 @@ class NativeStore:
         )
 
     def unpin_and_delete(self, object_id) -> None:
-        """Owner-side delete: drop the owner pin; if readers still hold views,
-        defer reclamation to the last reader's finalizer."""
+        """Owner-side delete: drop the owner pin; if readers (in any process)
+        still hold views, tps_delete marks the shared delete_pending bit and
+        the last release reclaims it."""
         key = self._key(object_id)
         self._lib.tps_release(self._handle, key)
-        rc = self._lib.tps_delete(self._handle, key)
-        if rc == -2:  # still pinned by reader views
-            with self._lock:
-                self._deferred_deletes.add(key)
+        self._lib.tps_delete(self._handle, key)
 
     def release(self, object_id) -> None:
         self._lib.tps_release(self._handle, self._key(object_id))
@@ -230,8 +230,10 @@ class NativeStore:
         rc = self._lib.tps_create(self._handle, self._key(object_id), total, ctypes.byref(out))
         if rc == -1:  # already stored (task retry reseal) — idempotent
             return total
-        if rc in (-2, -3):
-            raise NativeStoreFullError(f"native store full ({total} bytes)")
+        # -2 full / -3 index full / -4 poisoned / -5 old payload mid-deferred-
+        # delete: in every case the caller stores the value elsewhere.
+        if rc in (-2, -3, -4, -5):
+            raise NativeStoreFullError(f"native store unavailable ({total} bytes)")
         if rc != 0:
             raise RuntimeError(f"tps_create failed rc={rc}")
         dest = (ctypes.c_uint8 * total).from_address(out.value)

@@ -267,6 +267,34 @@ class InProcessStore:
                 cb()
         return True
 
+    def invalidate(self, object_id: ObjectID) -> None:
+        """Reset a lost object's entry to the unsealed state so the lineage
+        re-execution's reseal can land and readers re-block on the event
+        (reference: ObjectRecoveryManager marking objects as being
+        reconstructed, object_recovery_manager.h:42)."""
+        dropped: list = []
+        was_native = False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed:
+                return
+            if entry.spilled_uri is None and not entry.in_native:
+                self._used -= entry.size
+            was_native = entry.in_native
+            dropped.append((entry.value, entry.nested_refs))
+            entry.value = None
+            entry.size = 0
+            entry.sealed = False
+            entry.freed = False
+            entry.in_native = False
+            entry.spilled_uri = None
+            entry.nested_refs = None
+            entry.event.clear()
+        if was_native and self._native is not None:
+            # Drop the owner pin so the shm payload doesn't leak; with reader
+            # pins outstanding the shared delete_pending bit completes it.
+            self._native.unpin_and_delete(object_id)
+
     def is_native(self, object_id: ObjectID) -> bool:
         """True if the sealed object's bytes live in the shared shm store."""
         with self._lock:
@@ -292,20 +320,30 @@ class InProcessStore:
     # -- read path ----------------------------------------------------------
 
     def get(self, object_id: ObjectID, timeout: float | None = None) -> Any:
-        entry = self._wait_entry(object_id, timeout)
-        # Decide the read mode ONCE under the lock — entry fields are mutable
-        # and a concurrent free() must not flip the branch mid-read.
-        with self._lock:
-            if entry.freed:
-                raise ObjectFreedError(object_id, f"Object {object_id} was freed")
-            entry.last_access = time.monotonic()
-            spilled_uri = entry.spilled_uri
-            in_native = entry.in_native
-            if spilled_uri is None and not in_native:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            entry = self._wait_entry(object_id, remaining)
+            # Decide the read mode ONCE under the lock — entry fields are
+            # mutable and a concurrent free()/invalidate() must not flip the
+            # branch mid-read.
+            with self._lock:
+                if entry.freed:
+                    raise ObjectFreedError(
+                        object_id, f"Object {object_id} was freed"
+                    )
+                if not entry.sealed:
+                    continue  # invalidated between event-wait and lock: re-wait
+                entry.last_access = time.monotonic()
+                spilled_uri = entry.spilled_uri
+                in_native = entry.in_native
                 value = entry.value
-                if not isinstance(value, _Pickled):
-                    return value
+                break
         if spilled_uri is None and not in_native:
+            if not isinstance(value, _Pickled):
+                return value
             # Deserialize outside the lock: a fresh copy per reader.
             import cloudpickle
 
@@ -322,9 +360,11 @@ class InProcessStore:
                     return cloudpickle.loads(restored.data)
                 return restored
             except FileNotFoundError:
-                # Raced with free()/delete() unlinking the spill file.
-                raise ObjectFreedError(
-                    object_id, f"Object {object_id} was freed"
+                # Intentional unlink (free/delete) clears spilled_uri first,
+                # so reaching here means the file vanished externally — a
+                # LOST object, recoverable via lineage re-execution.
+                raise ObjectLostError(
+                    object_id, f"Spill file for {object_id} is missing"
                 ) from None
         # Deserialize outside the lock; arrays come back as zero-copy views
         # pinning the shm object until they are garbage collected.

@@ -51,6 +51,9 @@ class ProcessWorkerHandle:
         self.runtime = engine.runtime
         self.actor_id: Optional[ActorID] = None
         self.expected_death = False
+        import time as _time
+
+        self.last_pong = _time.monotonic()
         self._lock = threading.Lock()
         # task_id bytes -> (spec, grant)
         self.in_flight: dict[bytes, tuple[TaskSpec, dict]] = {}
@@ -248,6 +251,10 @@ class ProcessWorkerHandle:
                     self.borrows[raw] = n - 1
             if n >= 1:
                 self.runtime.refcount.remove_local_reference(ObjectID(raw))
+        elif kind == "pong":
+            import time
+
+            self.last_pong = time.monotonic()
         elif kind == "ready":
             pass
 
@@ -346,7 +353,7 @@ class ProcessWorkerHandle:
                 data = runtime.store.get_serialized(oid)
                 if data is not None:
                     return {"value_pickled": data}
-            value = runtime.store.get(oid, timeout)
+            value = runtime.get_value(oid, timeout)
             from ray_tpu._private.runtime import ErrorObject
 
             if isinstance(value, ErrorObject):
@@ -571,6 +578,15 @@ class ProcessNodeEngine:
                 daemon=True,
             )
             reaper.start()
+        period = runtime.config.health_check_period_s
+        if period and period > 0:
+            prober = threading.Thread(
+                target=self._health_loop,
+                args=(period, runtime.config.health_check_failure_threshold),
+                name=f"health-{node.node_id.hex()[:6]}",
+                daemon=True,
+            )
+            prober.start()
 
     # -- pool --------------------------------------------------------------
 
@@ -594,6 +610,34 @@ class ProcessNodeEngine:
         with self._lock:
             self._workers.discard(handle)
             self._idle = [(h, t) for h, t in self._idle if h is not handle]
+
+    def _health_loop(self, period: float, threshold: int) -> None:
+        """Active liveness probing of every worker process: ping each period;
+        a worker silent for period*threshold is hung (native-code livelock,
+        deadlocked recv thread) and is killed so its tasks fail-and-retry
+        through the normal crash path (gcs_health_check_manager.h:39)."""
+        import time
+
+        deadline = max(period * max(1, threshold), period + 1.0)
+        while self.alive:
+            time.sleep(period)
+            with self._lock:
+                workers = list(self._workers)
+            now = time.monotonic()
+            for handle in workers:
+                if handle.expected_death:
+                    continue
+                if now - handle.last_pong > deadline:
+                    # Unexpected kill: EOF cleanup treats it as a crash.
+                    try:
+                        handle.proc.kill()
+                    except Exception:
+                        pass
+                    continue
+                try:
+                    handle.conn.send("ping", {"id": int(now)})
+                except Exception:
+                    pass  # reader will observe the EOF
 
     def _reap_loop(self, idle_s: float) -> None:
         """Kill workers idle longer than idle_worker_killing_time_s

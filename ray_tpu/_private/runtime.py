@@ -143,6 +143,23 @@ class Runtime:
         self.shutting_down = False
         self.namespace = namespace
         self.controller = Controller()
+        # Control-plane persistence: KV + job counter must be restored BEFORE
+        # this session mints its job id; actors/PGs are restored at the end
+        # of init once the scheduler and head node exist.
+        self._gcs_storage = None
+        self._pending_snapshot = None
+        if self.config.gcs_storage_path:
+            from ray_tpu._private.gcs_storage import GcsStorage
+
+            self._gcs_storage = GcsStorage(self.config.gcs_storage_path)
+            self._pending_snapshot = self._gcs_storage.load()
+            if self._pending_snapshot:
+                with self.controller._lock:
+                    self.controller._kv.update(self._pending_snapshot.get("kv", {}))
+                    self.controller._job_counter = max(
+                        self.controller._job_counter,
+                        self._pending_snapshot.get("job_counter", 0),
+                    )
         budget = self.config.object_store_memory or _default_store_budget(self.config)
         self._native_store = None
         if self.config.native_store_enabled and self.config.native_store_threshold:
@@ -171,7 +188,15 @@ class Runtime:
         )
         self.refcount = ReferenceCounter(
             on_object_out_of_scope=lambda oid: self.store.delete([oid]),
+            on_lineage_released=self._release_lineage,
         )
+        # Lineage table: producing spec kept while any output is referenced,
+        # enabling re-execution of lost objects (reference: lineage pinning,
+        # reference_count.h:75 + object_recovery_manager.h:42). The retained
+        # spec's arg ObjectRefs transitively pin upstream lineage via ordinary
+        # handle liveness.
+        self._lineage: dict[TaskID, tuple[TaskSpec, dict]] = {}
+        self._recovering: dict[TaskID, threading.Event] = {}
         self.store.set_pinned_check(self.refcount.pinned)
         self.job_id = JobID.from_int(self.controller.next_job_id())
         self.driver_task_id = TaskID.for_job(self.job_id)
@@ -197,9 +222,40 @@ class Runtime:
         self.scheduler = Scheduler(
             self.controller, dispatch=self._dispatch, fail_task=self._fail_unscheduled
         )
+        # Handles pinning detached actors' creation objects (their lifetime is
+        # the cluster's, not any caller's) — also the restore target for
+        # control-plane persistence.
+        self._detached_creation_refs: list = []
         _RUNTIME = self
         if resources is not None:
             self.add_node(resources, is_head=True)
+        if self._gcs_storage is not None:
+            from ray_tpu._private.gcs_storage import restore_snapshot
+
+            if self._pending_snapshot:
+                restore_snapshot(self, self._pending_snapshot)
+                self._pending_snapshot = None
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, name="gcs-persist", daemon=True
+            )
+            self._persist_thread.start()
+
+    def _persist_loop(self) -> None:
+        """Debounced control-plane flush (the reference writes GCS tables to
+        Redis asynchronously; a crash loses at most one interval)."""
+        import time as _time
+
+        from ray_tpu._private.gcs_storage import build_snapshot
+
+        interval = max(0.5, self.config.health_check_period_s)
+        while not self.shutting_down:
+            _time.sleep(interval)
+            if self.shutting_down:
+                return
+            try:
+                self._gcs_storage.save(build_snapshot(self))
+            except Exception:
+                pass  # disk hiccup: retry next interval
 
     # ------------------------------------------------------------------ nodes
 
@@ -302,11 +358,76 @@ class Runtime:
             remaining = None
             if deadline is not None:
                 remaining = max(0.0, deadline - _time.monotonic())
-            value = self.store.get(ref.id, remaining)
+            value = self.get_value(ref.id, remaining)
             if isinstance(value, ErrorObject):
                 value.raise_()
             values.append(value)
         return values
+
+    def get_value(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        """store.get with lineage recovery: a LOST value (missing spill file,
+        shm eviction) re-executes its producing task instead of raising
+        (reference: ObjectRecoveryManager, object_recovery_manager.h:42).
+        Explicitly freed objects (ObjectFreedError) are never recovered."""
+        from ray_tpu.exceptions import ObjectFreedError
+
+        for _attempt in range(3):
+            try:
+                return self.store.get(oid, timeout)
+            except ObjectFreedError:
+                raise
+            except ObjectLostError:
+                if not self._try_recover(oid):
+                    raise
+        return self.store.get(oid, timeout)
+
+    # ------------------------------------------------------------ recovery
+
+    def _release_lineage(self, task_id: TaskID) -> None:
+        with self._lock:
+            self._lineage.pop(task_id, None)
+
+    def _try_recover(self, oid: ObjectID) -> bool:
+        """Re-execute the producing task of a lost object. Returns False if
+        no lineage is retained (put objects, streaming items, actor tasks)."""
+        task_id = oid.task_id
+        with self._lock:
+            entry = self._lineage.get(task_id)
+        if entry is None:
+            return False
+        spec, request = entry
+        with self._lock:
+            event = self._recovering.get(task_id)
+            leader = event is None
+            if leader:
+                event = threading.Event()
+                self._recovering[task_id] = event
+        if not leader:
+            # Another thread is already reconstructing this task's outputs.
+            event.wait(timeout=300)
+            return True
+        try:
+            # Recursively ensure the args exist (their own recovery may
+            # re-execute upstream producers).
+            for dep in self._dep_ids(spec):
+                try:
+                    self.get_value(dep, timeout=None)
+                except ObjectLostError:
+                    return False  # upstream unrecoverable
+            for ret in spec.return_ids:
+                self.store.invalidate(ret)
+            with self._lock:
+                self._task_records[spec.task_id] = _TaskRecord(spec, request)
+            self.task_events.record(
+                spec.task_id, "PENDING_ARGS_AVAIL", name=spec.name,
+                kind="RECOVERY", job_id=spec.job_id,
+            )
+            self._submit_when_ready(spec, request)
+            return True
+        finally:
+            with self._lock:
+                self._recovering.pop(task_id, None)
+            event.set()
 
     # ------------------------------------------------------------------ wait
 
@@ -368,6 +489,11 @@ class Runtime:
             refs.append(ObjectRef(oid))
         with self._lock:
             self._task_records[spec.task_id] = _TaskRecord(spec, resources)
+            if not streaming and spec.return_ids:
+                # Streaming outputs can't be deterministically re-yielded, and
+                # num_returns=0 tasks have nothing to recover (their lineage
+                # release would also never fire — no tracked outputs).
+                self._lineage[spec.task_id] = (spec, dict(resources))
         if streaming:
             gen = self._register_stream(spec, completion_ref=refs[0])
             self._submit_when_ready(spec, resources)
@@ -523,6 +649,10 @@ class Runtime:
         self.controller.register_actor(record)
         self.refcount.add_owned_object(spec.return_ids[0], owner_task=spec.task_id)
         creation_ref = ObjectRef(spec.return_ids[0])
+        if detached:
+            # A detached actor's lifetime is the cluster's: pin its creation
+            # object so dropping the user handle can't collect it.
+            self._detached_creation_refs.append(creation_ref)
         with self._lock:
             self._actor_specs[actor_id] = spec
             self._actor_buffers[actor_id] = []
@@ -755,7 +885,7 @@ class Runtime:
 
         def resolve(value):
             if isinstance(value, ObjectRef):
-                stored = self.store.get(value.id, timeout=30.0)
+                stored = self.get_value(value.id, timeout=30.0)
                 if isinstance(stored, ErrorObject):
                     stored.raise_()
                 return stored
@@ -965,6 +1095,13 @@ class Runtime:
 
     def shutdown(self) -> None:
         global _RUNTIME
+        if self._gcs_storage is not None:
+            from ray_tpu._private.gcs_storage import build_snapshot
+
+            try:
+                self._gcs_storage.save(build_snapshot(self))
+            except Exception:
+                pass
         self.shutting_down = True
         self.scheduler.shutdown()
         with self._lock:

@@ -44,13 +44,16 @@ from ray_tpu.util.scheduling_strategies import (
 
 
 class PendingTask:
-    __slots__ = ("spec", "request", "target_node", "cancelled", "shape")
+    __slots__ = ("spec", "request", "target_node", "cancelled", "shape", "claimed")
 
     def __init__(self, spec: TaskSpec, request: dict[str, float]):
         self.spec = spec
         self.request = request
         self.target_node: Optional[NodeState] = None
         self.cancelled = False
+        # Set while the pass is dispatching this task: cancel() must not
+        # match a task whose dispatch is in flight (it would double-finalize).
+        self.claimed = False
         self.shape = _shape_key(spec, request)
 
 
@@ -129,7 +132,11 @@ class Scheduler:
             # The current pass's drained batch is still cancellable: the loop
             # re-checks pending.cancelled right before dispatching each task.
             for pending in list(self._queue) + self._in_pass:
-                if pending.spec.task_id == task_id and not pending.cancelled:
+                if (
+                    pending.spec.task_id == task_id
+                    and not pending.cancelled
+                    and not pending.claimed
+                ):
                     pending.cancelled = True
                     self._cond.notify_all()
                     return True
@@ -152,7 +159,12 @@ class Scheduler:
 
     def pending_demand(self) -> list[dict[str, float]]:
         with self._cond:
-            return [p.request for p in self._queue]
+            # Include the pass in flight: an autoscaler snapshot taken while
+            # the loop holds the drained batch must still see its demand.
+            seen = {id(p) for p in self._queue}
+            return [p.request for p in self._queue] + [
+                p.request for p in self._in_pass if id(p) not in seen
+            ]
 
     def shutdown(self) -> None:
         with self._cond:
@@ -194,12 +206,16 @@ class Scheduler:
         leftovers: list[PendingTask] = []
         blocked_shapes: set = set()
         for pending in batch:
-            if pending.cancelled:
-                progressed = True
-                continue
             if pending.shape in blocked_shapes:
                 leftovers.append(pending)
                 continue
+            # Claim under the lock: after this point cancel() returns False
+            # for this task (it may already be dispatching).
+            with self._cond:
+                if pending.cancelled:
+                    progressed = True
+                    continue
+                pending.claimed = True
             try:
                 request, pg_record = resolve_pg_request(
                     pending.spec, pending.request, self._controller
@@ -231,6 +247,7 @@ class Scheduler:
                     for fn in self._demand_listeners:
                         fn(request)
                 blocked_shapes.add(pending.shape)
+                pending.claimed = False  # re-queued: cancellable again
                 leftovers.append(pending)
                 continue
             if node.allocate(request):
@@ -238,6 +255,7 @@ class Scheduler:
                 self._dispatch(pending.spec, node, request)
             else:
                 blocked_shapes.add(pending.shape)
+                pending.claimed = False
                 leftovers.append(pending)
         return leftovers, progressed
 

@@ -431,6 +431,14 @@ class Worker:
                     event, slot = waiter
                     slot.update(body)
                     event.set()
+            elif kind == "ping":
+                # Health probe: answered from the recv thread so a worker
+                # whose executor is busy still pongs; only a truly wedged
+                # process (GIL held by native code, deadlock) goes silent.
+                try:
+                    self.conn.send("pong", {"id": body.get("id")})
+                except Exception:
+                    break
             elif kind == "kill":
                 break
             else:

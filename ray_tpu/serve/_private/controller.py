@@ -225,8 +225,12 @@ class ServeControllerActor:
         period = float(getattr(cfg, "health_check_period_s", 1.0) or 0)
         if period <= 0:
             return
+        timeout_threshold = int(
+            getattr(cfg, "health_check_failure_threshold", 3) or 3
+        )
         if not hasattr(st, "last_health"):
             st.last_health = {}
+            st.health_timeouts = {}
         now = time.time()
         due = {}
         with self._lock:
@@ -237,18 +241,35 @@ class ServeControllerActor:
                         due[tag] = h.check_health.remote()
                     except Exception:
                         pass
+        if not due:
+            return
+        # Wait on all probes COLLECTIVELY: one slow replica must not stall
+        # the control loop for 2s x replicas (reference waits on the whole
+        # batch, deployment_state.py health checking).
+        ready, _ = ray.wait(list(due.values()), num_returns=len(due), timeout=2.0)
+        ready_set = set(ready)
         for tag, ref in due.items():
             healthy = True
-            try:
-                healthy = bool(ray.get(ref, timeout=2.0))
-            except ActorDiedError:
-                healthy = False
-            except Exception:
-                pass  # transient (slow init): keep the replica
+            if ref in ready_set:
+                try:
+                    healthy = bool(ray.get(ref, timeout=0))
+                    st.health_timeouts[tag] = 0
+                except ActorDiedError:
+                    healthy = False
+                except Exception:
+                    healthy = False  # check itself raised: the probe failed
+            else:
+                # Timed out: transient a few times, dead past the threshold —
+                # a hung-but-alive replica must eventually be replaced
+                # (ADVICE r1: timeouts were treated as transient forever).
+                misses = st.health_timeouts.get(tag, 0) + 1
+                st.health_timeouts[tag] = misses
+                healthy = misses < timeout_threshold
             if not healthy:
                 with self._lock:
                     h = st.replicas.pop(tag, None)
                     st.last_health.pop(tag, None)
+                    st.health_timeouts.pop(tag, None)
                     self._bump()
                 if h is not None:
                     try:

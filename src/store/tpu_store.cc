@@ -28,7 +28,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5450555354524531ULL;  // "TPUSTRE1"
+constexpr uint64_t kMagic = 0x5450555354524532ULL;  // "TPUSTRE2"
 constexpr uint32_t kIdSize = 32;                    // ObjectID padded to 32B
 constexpr uint64_t kAlign = 64;                     // cacheline-aligned blocks
 
@@ -41,6 +41,12 @@ struct Slot {
   uint64_t last_access;
   int32_t state;  // 0 empty, 1 created, 2 sealed, 3 tombstone
   int32_t refcount;
+  // Owner requested deletion while readers held pins: the LAST release (from
+  // ANY process) reclaims the payload. Lives in the shared segment so the
+  // decision survives the requesting process (plasma defers reclamation the
+  // same way).
+  uint32_t delete_pending;
+  uint32_t pad;
 };
 
 enum SlotState { kEmpty = 0, kCreated = 1, kSealed = 2, kTombstone = 3 };
@@ -66,6 +72,10 @@ struct Header {
   uint64_t num_objects;   // created + sealed
   uint64_t lru_clock;
   uint64_t free_head;     // offset of first free block (0 = none)
+  // Set when EOWNERDEAD repair found unrecoverable arena corruption: every
+  // subsequent operation fails with -4 and callers fall back to the
+  // in-process store rather than corrupting each other further.
+  uint64_t poisoned;
   pthread_mutex_t mutex;
 };
 
@@ -222,6 +232,93 @@ uint64_t alloc_with_eviction(Store* s, uint64_t payload) {
   return off;
 }
 
+// -------------------------------------------------------------- repair
+//
+// A worker killed while holding the robust mutex may have died mid-surgery
+// (arena_alloc/coalesce/evict half-applied). pthread_mutex_consistent only
+// repairs the LOCK; this rebuilds the DATA from first principles:
+//   pass 1: walk physical blocks by size fields (authoritative), fixing
+//           prev_size links and rebuilding the free list from free_flag;
+//   pass 2: validate every occupied slot (payload within a used block);
+//           invalid slots are tombstoned; used/num_objects recomputed;
+//   pass 3: used blocks no valid slot points at (death between alloc and
+//           slot publish) are returned to the free list.
+// Any structurally-impossible size poisons the segment instead of guessing.
+
+int repair_store(Store* s) {
+  Header* h = s->hdr;
+  // Pass 1: physical walk.
+  h->free_head = 0;
+  uint64_t off = 0;
+  uint64_t prev_size = 0;
+  while (off < h->arena_size) {
+    BlockHeader* b = block_at(s, off);
+    if (b->size < sizeof(BlockHeader) || b->size % kAlign != 0 ||
+        off + b->size > h->arena_size) {
+      return -1;  // unrecoverable: block chain is broken
+    }
+    b->prev_size = prev_size;
+    b->pad = 0;  // mark bit for pass 3
+    if (b->free_flag) {
+      b->next_free = h->free_head;
+      b->prev_free = 0;
+      if (h->free_head) block_at(s, h->free_head)->prev_free = off;
+      h->free_head = off;
+    }
+    prev_size = b->size;
+    off += b->size;
+  }
+  if (off != h->arena_size) return -1;
+  // Pass 2: slot validation + accounting rebuild.
+  uint64_t used = 0;
+  uint64_t num_objects = 0;
+  for (uint64_t i = 0; i < h->table_slots; i++) {
+    Slot* slot = &s->slots[i];
+    if (slot->state != kCreated && slot->state != kSealed) continue;
+    bool valid = slot->offset + sizeof(BlockHeader) + slot->size <= h->arena_size &&
+                 slot->offset % kAlign == 0;
+    if (valid) {
+      BlockHeader* b = block_at(s, slot->offset);
+      valid = !b->free_flag &&
+              slot->size + sizeof(BlockHeader) <= b->size;
+    }
+    if (!valid) {
+      slot->state = kTombstone;
+      continue;
+    }
+    block_at(s, slot->offset)->pad = 1;
+    used += slot->size;
+    num_objects++;
+  }
+  h->used = used;
+  h->num_objects = num_objects;
+  // Pass 3: reclaim orphaned used blocks (skip the offset-0 sentinel).
+  // Collect first, free after: arena_free coalesces, which would invalidate
+  // headers ahead of an in-progress walk.
+  uint64_t* orphans = new uint64_t[1024];
+  uint64_t n_orphans = 0;
+  uint64_t cap_orphans = 1024;
+  off = 0;
+  while (off < h->arena_size) {
+    BlockHeader* b = block_at(s, off);
+    uint64_t size = b->size;
+    if (off != 0 && !b->free_flag && !b->pad) {
+      if (n_orphans == cap_orphans) {
+        uint64_t* bigger = new uint64_t[cap_orphans * 2];
+        memcpy(bigger, orphans, n_orphans * sizeof(uint64_t));
+        delete[] orphans;
+        orphans = bigger;
+        cap_orphans *= 2;
+      }
+      orphans[n_orphans++] = off;
+    }
+    off += size;
+  }
+  for (uint64_t i = 0; i < n_orphans; i++) arena_free(s, orphans[i]);
+  delete[] orphans;
+  return 0;
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------ C ABI
@@ -315,23 +412,44 @@ Store* tps_open(const char* name, uint64_t capacity, uint64_t slots) {
   return s;
 }
 
-static void lock_store(Store* s) {
+// Returns 0 normally; -4 when the segment is poisoned (caller must unlock
+// and fail the operation).
+static int lock_store(Store* s) {
   int rc = pthread_mutex_lock(&s->hdr->mutex);
-  if (rc == EOWNERDEAD) pthread_mutex_consistent(&s->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // A worker died holding the lock: the lock is ours again, but the data
+    // it was mutating may be half-applied. Rebuild or poison before letting
+    // anyone touch the arena.
+    pthread_mutex_consistent(&s->hdr->mutex);
+    if (!s->hdr->poisoned && repair_store(s) != 0) s->hdr->poisoned = 1;
+  }
+  return s->hdr->poisoned ? -4 : 0;
 }
+
+#define LOCK_OR_FAIL(s)                        \
+  do {                                         \
+    if (lock_store(s) != 0) {                  \
+      pthread_mutex_unlock(&(s)->hdr->mutex);  \
+      return -4;                               \
+    }                                          \
+  } while (0)
 
 // Allocate an object buffer; caller writes payload then calls tps_seal.
 // Returns 0 ok, -1 exists, -2 out of memory, -3 table full.
 int tps_create(Store* s, const uint8_t* id, uint64_t size, void** out) {
-  lock_store(s);
+  LOCK_OR_FAIL(s);
   Slot* slot = find_slot(s, id, true);
   if (!slot) {
     pthread_mutex_unlock(&s->hdr->mutex);
     return -3;
   }
   if (slot->state == kCreated || slot->state == kSealed) {
+    // -5: the old payload is awaiting a deferred delete (readers still pin
+    // it) — a reseal under the same id can't succeed, the caller must store
+    // elsewhere. -1: idempotent reseal of a live object.
+    int rc = slot->delete_pending ? -5 : -1;
     pthread_mutex_unlock(&s->hdr->mutex);
-    return -1;
+    return rc;
   }
   uint64_t off = alloc_with_eviction(s, size);
   if (!off) {
@@ -343,6 +461,7 @@ int tps_create(Store* s, const uint8_t* id, uint64_t size, void** out) {
   slot->size = size;
   slot->state = kCreated;
   slot->refcount = 0;
+  slot->delete_pending = 0;
   slot->last_access = ++s->hdr->lru_clock;
   s->hdr->used += size;
   s->hdr->num_objects++;
@@ -352,7 +471,7 @@ int tps_create(Store* s, const uint8_t* id, uint64_t size, void** out) {
 }
 
 int tps_seal(Store* s, const uint8_t* id) {
-  lock_store(s);
+  LOCK_OR_FAIL(s);
   Slot* slot = find_slot(s, id, false);
   int rc = 0;
   if (!slot || slot->state != kCreated)
@@ -374,9 +493,9 @@ int tps_put(Store* s, const uint8_t* id, const void* data, uint64_t size) {
 
 // Pin + return payload pointer. 0 ok, -1 not found / unsealed.
 int tps_get(Store* s, const uint8_t* id, const void** data, uint64_t* size) {
-  lock_store(s);
+  LOCK_OR_FAIL(s);
   Slot* slot = find_slot(s, id, false);
-  if (!slot || slot->state != kSealed) {
+  if (!slot || slot->state != kSealed || slot->delete_pending) {
     pthread_mutex_unlock(&s->hdr->mutex);
     return -1;
   }
@@ -389,33 +508,44 @@ int tps_get(Store* s, const uint8_t* id, const void** data, uint64_t* size) {
 }
 
 int tps_release(Store* s, const uint8_t* id) {
-  lock_store(s);
+  LOCK_OR_FAIL(s);
   Slot* slot = find_slot(s, id, false);
   int rc = 0;
-  if (!slot || slot->refcount <= 0)
+  if (!slot || slot->refcount <= 0) {
     rc = -1;
-  else
+  } else {
     slot->refcount--;
+    // Deferred owner-delete: whichever process drops the LAST pin reclaims
+    // the payload (the flag lives in the shared slot, so it doesn't matter
+    // which process asked for the delete or whether it is still alive).
+    if (slot->refcount == 0 && slot->delete_pending) evict_payload(s, slot);
+  }
   pthread_mutex_unlock(&s->hdr->mutex);
   return rc;
 }
 
 int tps_contains(Store* s, const uint8_t* id) {
-  lock_store(s);
+  if (lock_store(s) != 0) {
+    pthread_mutex_unlock(&s->hdr->mutex);
+    return 0;
+  }
   Slot* slot = find_slot(s, id, false);
   int rc = (slot && slot->state == kSealed) ? 1 : 0;
   pthread_mutex_unlock(&s->hdr->mutex);
   return rc;
 }
 
-// Delete if unpinned (refcount 0). 0 ok, -1 not found, -2 pinned.
+// Delete if unpinned (refcount 0). 0 ok, -1 not found, -2 pinned (the
+// delete is recorded in the shared slot and completes on the last release,
+// from whichever process holds it).
 int tps_delete(Store* s, const uint8_t* id) {
-  lock_store(s);
+  LOCK_OR_FAIL(s);
   Slot* slot = find_slot(s, id, false);
   int rc = 0;
   if (!slot || (slot->state != kSealed && slot->state != kCreated)) {
     rc = -1;
   } else if (slot->refcount > 0) {
+    slot->delete_pending = 1;
     rc = -2;
   } else {
     evict_payload(s, slot);
@@ -435,5 +565,11 @@ void tps_close(Store* s) {
 
 // Unlink the segment (node shutdown); existing mappings stay valid.
 int tps_destroy(const char* name) { return shm_unlink(name); }
+
+// TEST-ONLY: acquire the store mutex and return WITHOUT unlocking, so a test
+// process can die while holding it and exercise the EOWNERDEAD repair path.
+int tps_debug_lock(Store* s) { return pthread_mutex_lock(&s->hdr->mutex); }
+
+int tps_poisoned(Store* s) { return s->hdr->poisoned ? 1 : 0; }
 
 }  // extern "C"

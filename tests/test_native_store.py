@@ -50,9 +50,10 @@ def test_contains_delete_pin(store):
     store.put_raw(b"k", b"payload")
     assert store.contains(b"k")
     assert store.pin(b"k")
-    assert not store.delete(b"k")  # pinned -> refused
+    assert not store.delete(b"k")  # pinned -> deferred via shared slot bit
+    # The deferred delete completes on the LAST release, whichever process
+    # performs it (delete_pending lives in the shared segment).
     store.release(b"k")
-    assert store.delete(b"k")
     assert not store.contains(b"k")
 
 
@@ -149,3 +150,58 @@ def test_runtime_integration_large_objects():
         assert small == 123
     finally:
         ray_tpu.shutdown()
+
+
+def test_cross_process_deferred_delete(store):
+    """A reader pin held in ANOTHER process defers the owner's delete; that
+    process's release completes it (shared delete_pending bit)."""
+    import subprocess
+    import sys
+
+    store.put_raw(b"xp", b"payload")
+    code = f"""
+import time
+from ray_tpu._private.native_store import NativeStore
+s = NativeStore({store.name!r})
+assert s.pin(b"xp")
+open({(store.name.decode() + ".pinned")!r}.replace("/", "/tmp/"), "w").write("1")
+time.sleep(1.0)
+s.release(b"xp")   # last release -> deferred delete completes
+"""
+    proc = subprocess.Popen([sys.executable, "-c", code])
+    import time
+
+    marker = (store.name.decode() + ".pinned").replace("/", "/tmp/")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not os.path.exists(marker):
+        time.sleep(0.05)
+    assert os.path.exists(marker), "child never pinned"
+    store.delete(b"xp")  # pinned remotely -> deferred
+    proc.wait(timeout=15)
+    os.unlink(marker)
+    assert not store.contains(b"xp")
+
+
+def test_eownerdead_repair_keeps_store_usable(store):
+    """A process dying WHILE HOLDING the store mutex must not wedge or
+    corrupt the segment: the next locker repairs and continues."""
+    import subprocess
+    import sys
+
+    store.put_raw(b"before", b"data-before")
+    code = f"""
+import os
+from ray_tpu._private.native_store import NativeStore
+s = NativeStore({store.name!r})
+s._lib.tps_debug_lock(s._handle)
+os._exit(1)   # die holding the robust mutex
+"""
+    subprocess.run([sys.executable, "-c", code], timeout=30)
+    # Next operation takes EOWNERDEAD, repairs, proceeds.
+    store.put_raw(b"after", b"data-after")
+    assert store.contains(b"before")
+    assert store.contains(b"after")
+    view = store.get_raw(b"after", track=False)
+    assert bytes(view) == b"data-after"
+    store.release(b"after")
+    assert store._lib.tps_poisoned(store._handle) == 0
