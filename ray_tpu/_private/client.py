@@ -1,0 +1,135 @@
+"""Client-mode runtime: a remote driver over TCP.
+
+`ray_tpu.init(address="host:port")` connects to a HeadServer
+(head_server.py) and installs a proxy runtime speaking the wire protocol —
+the full public API works against the remote control plane. Reuses the
+worker-side proxy (worker_main.WorkerProxyRuntime): a client is just a peer
+that never executes tasks.
+
+When the head is on the SAME machine (hostnames match), the client attaches
+the head's shared-memory store and reads large objects zero-copy instead of
+over the socket.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from ray_tpu._private import wire
+from ray_tpu._private.ids import JobID, TaskID
+
+
+class ClientCore:
+    """Worker-duck-typed connection core for WorkerProxyRuntime: conn + rpc
+    + identity, without the task-execution half."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, _, port = address.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        self.conn = wire.Connection(sock)
+        msg = self.conn.recv()
+        if msg is None or msg[0] != "hello":
+            raise ConnectionError(f"bad handshake from {address}")
+        hello = msg[1]
+        self.job_id = JobID(hello["job_id"])
+        self.driver_task_id = TaskID(hello["driver_task_id"])
+        self.namespace = hello.get("namespace", "default")
+        self.native = None
+        if (
+            hello.get("store_name")
+            and hello.get("hostname") == socket.gethostname()
+        ):
+            try:
+                from ray_tpu._private import native_store
+
+                if native_store.native_store_available():
+                    self.native = native_store.NativeStore(hello["store_name"])
+            except Exception:
+                self.native = None
+        self._rpc_counter = 0
+        self._rpc_lock = threading.Lock()
+        self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
+        self.closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="client-reader", daemon=True
+        )
+        self._reader.start()
+
+    def rpc(self, method: str, payload: dict):
+        with self._rpc_lock:
+            if self.closed:
+                raise ConnectionError("client connection closed")
+            self._rpc_counter += 1
+            msg_id = self._rpc_counter
+            event = threading.Event()
+            slot: dict = {}
+            self._rpc_waiters[msg_id] = (event, slot)
+        self.conn.send("rpc", {"id": msg_id, "method": method, "payload": payload})
+        event.wait()
+        if slot.get("dead"):
+            raise ConnectionError("head connection lost")
+        if slot["ok"]:
+            return slot["result"]
+        raise slot["exc"]
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                msg = None
+            if msg is None:
+                break
+            kind, body = msg
+            if kind == "rpc_reply":
+                with self._rpc_lock:
+                    waiter = self._rpc_waiters.pop(body["id"], None)
+                if waiter is not None:
+                    event, slot = waiter
+                    slot.update(body)
+                    event.set()
+            elif kind == "ping":
+                try:
+                    self.conn.send("pong", {"id": body.get("id")})
+                except Exception:
+                    break
+        self._fail_all()
+
+    def _fail_all(self) -> None:
+        with self._rpc_lock:
+            self.closed = True
+            waiters = list(self._rpc_waiters.values())
+            self._rpc_waiters.clear()
+        for event, slot in waiters:
+            slot["dead"] = True
+            event.set()
+
+    def close(self) -> None:
+        self.closed = True
+        self.conn.close()
+
+
+def connect(address: str, namespace: Optional[str] = None, timeout: float = 30.0):
+    """Build the client proxy runtime (returned AND installed by api.init)."""
+    from ray_tpu._private.worker_main import WorkerProxyRuntime
+
+    core = ClientCore(address, timeout)
+    if namespace and namespace != "default":
+        core.namespace = namespace  # client-chosen namespace for named actors
+    proxy = WorkerProxyRuntime(core)
+    proxy._client_core = core
+
+    def shutdown():
+        from ray_tpu._private import runtime as runtime_mod
+
+        proxy.shutting_down = True
+        core.close()
+        if runtime_mod._RUNTIME is proxy:
+            runtime_mod._RUNTIME = None
+
+    proxy.shutdown = shutdown
+    return proxy

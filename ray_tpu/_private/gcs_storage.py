@@ -64,7 +64,11 @@ class GcsStorage:
 
 
 def build_snapshot(runtime) -> dict:
-    """Collect the durable control-plane tables from a live runtime."""
+    """Collect the durable control-plane tables from a live runtime.
+
+    Locks are held only for shallow copies; the (potentially large) actor-
+    spec serialization happens OUTSIDE both locks so a multi-MB constructor
+    arg can't stall scheduling for the duration of a pickle."""
     controller = runtime.controller
     with controller._lock:
         kv = dict(controller._kv)
@@ -79,26 +83,35 @@ def build_snapshot(runtime) -> dict:
             for record in controller.placement_groups.values()
             if record.state.value != "REMOVED"
         ]
-        detached = []
-        for record in controller.actors.values():
-            if not record.detached or record.state.value == "DEAD":
-                continue
-            spec = runtime._actor_specs.get(record.actor_id)
-            if spec is None:
-                continue
-            try:
-                spec_bytes = cloudpickle.dumps(spec, protocol=5)
-            except Exception:
-                continue  # unpicklable creation spec: not durable
-            detached.append(
-                {
-                    "spec": spec_bytes,
-                    "name": record.name,
-                    "namespace": record.namespace,
-                    "max_restarts": record.max_restarts,
-                    "class_name": record.class_name,
-                }
-            )
+        live_detached = [
+            (record.actor_id, record.name, record.namespace,
+             record.max_restarts, record.class_name)
+            for record in controller.actors.values()
+            if record.detached and record.state.value != "DEAD"
+        ]
+    with runtime._lock:
+        specs = {
+            actor_id: runtime._actor_specs.get(actor_id)
+            for actor_id, *_ in live_detached
+        }
+    detached = []
+    for actor_id, name, namespace, max_restarts, class_name in live_detached:
+        spec = specs.get(actor_id)
+        if spec is None:
+            continue
+        try:
+            spec_bytes = cloudpickle.dumps(spec, protocol=5)
+        except Exception:
+            continue  # unpicklable creation spec: not durable
+        detached.append(
+            {
+                "spec": spec_bytes,
+                "name": name,
+                "namespace": namespace,
+                "max_restarts": max_restarts,
+                "class_name": class_name,
+            }
+        )
     return {
         "version": 1,
         "kv": kv,

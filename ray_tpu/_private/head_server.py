@@ -1,0 +1,135 @@
+"""TCP control-plane server: remote drivers speak the wire protocol.
+
+The ray-client analog (reference: python/ray/util/client/ — a gRPC proxy
+letting a remote interactive driver use a cluster via `ray://host:port`).
+Here the head runtime listens on TCP and serves the SAME framed-RPC surface
+workers use (process_engine.WirePeer), so a client process gets the full API
+(put/get/wait/remote/actors/streaming) with per-client borrow accounting
+that is dropped when the connection closes.
+
+Start server-side:  runtime.serve_clients(host, port)  or
+                    ray_tpu.init(num_cpus=..., client_server_port=...)
+Connect client-side: ray_tpu.init(address="host:port")
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+from ray_tpu._private import wire
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.process_engine import WirePeer
+
+
+class ClientHandle(WirePeer):
+    """One connected remote driver."""
+
+    def __init__(self, server: "HeadServer", conn: wire.Connection):
+        super().__init__(server.runtime)
+        self.server = server
+        self.conn = conn
+        self.rpc_pool = server.rpc_pool
+        runtime = server.runtime
+        # Each client acts as a driver task of the head's job: its submitted
+        # tasks parent under a fresh driver task id.
+        self.driver_task_id = TaskID.for_job(runtime.job_id)
+        native = runtime._native_store
+        conn.send(
+            "hello",
+            {
+                "job_id": runtime.job_id.binary(),
+                "driver_task_id": self.driver_task_id.binary(),
+                "namespace": runtime.namespace,
+                "hostname": socket.gethostname(),
+                "store_name": native.name.decode() if native is not None else None,
+            },
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, name="client-conn", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                traceback.print_exc()
+                msg = None
+            if msg is None:
+                break
+            kind, body = msg
+            try:
+                if kind == "rpc":
+                    self.rpc_pool.submit(self._handle_rpc, body)
+                elif kind == "incref":
+                    self._handle_incref(body)
+                elif kind == "decref":
+                    self._handle_decref(body)
+                elif kind == "ping":
+                    self.conn.send("pong", {"id": body.get("id")})
+            except Exception:
+                traceback.print_exc()
+        self._drop_all_borrows()
+        self.server.forget(self)
+        self.conn.close()
+
+
+class HeadServer:
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self.runtime = runtime
+        self.rpc_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="head-rpc"
+        )
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._clients: set[ClientHandle] = set()
+        self._lock = threading.Lock()
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="head-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                handle = ClientHandle(self, wire.Connection(sock))
+            except Exception:
+                traceback.print_exc()
+                sock.close()
+                continue
+            with self._lock:
+                self._clients.add(handle)
+
+    def forget(self, handle: ClientHandle) -> None:
+        with self._lock:
+            self._clients.discard(handle)
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            clients = list(self._clients)
+            self._clients.clear()
+        for handle in clients:
+            handle.conn.close()
+        self.rpc_pool.shutdown(wait=False, cancel_futures=True)

@@ -295,6 +295,30 @@ class InProcessStore:
             # pins outstanding the shared delete_pending bit completes it.
             self._native.unpin_and_delete(object_id)
 
+    def is_available(self, object_id: ObjectID) -> bool:
+        """Cheap availability probe WITHOUT materializing: sealed and its
+        bytes are actually reachable (in-memory, spill file exists, or shm
+        contains it). Used by recovery to avoid deserializing healthy deps."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed:
+                return False
+            spilled_uri = entry.spilled_uri
+            in_native = entry.in_native
+        if spilled_uri is not None:
+            import os as _os
+
+            return _os.path.exists(spilled_uri)
+        if in_native:
+            return self._native is not None and self._native.contains(object_id)
+        return True
+
+    def was_freed(self, object_id: ObjectID) -> bool:
+        """True if the object was explicitly freed (never recoverable)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.freed
+
     def is_native(self, object_id: ObjectID) -> bool:
         """True if the sealed object's bytes live in the shared shm store."""
         with self._lock:
@@ -360,9 +384,14 @@ class InProcessStore:
                     return cloudpickle.loads(restored.data)
                 return restored
             except FileNotFoundError:
-                # Intentional unlink (free/delete) clears spilled_uri first,
-                # so reaching here means the file vanished externally — a
-                # LOST object, recoverable via lineage re-execution.
+                # Distinguish a racing free() (it clears spilled_uri and
+                # unlinks AFTER we captured the uri) from external file loss:
+                # freed objects must NOT be resurrected by lineage recovery.
+                with self._lock:
+                    if entry.freed or entry.spilled_uri != spilled_uri:
+                        raise ObjectFreedError(
+                            object_id, f"Object {object_id} was freed"
+                        ) from None
                 raise ObjectLostError(
                     object_id, f"Spill file for {object_id} is missing"
                 ) from None

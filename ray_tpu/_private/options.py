@@ -43,6 +43,11 @@ class ActorOptions(CommonOptions):
     lifetime: Optional[str] = None  # None | "detached"
     get_if_exists: bool = False
     namespace: Optional[str] = None
+    # Per-actor isolation override: "process" forces a dedicated OS worker
+    # process even when the runtime runs the threaded engine. Required by
+    # actors that must own a fresh interpreter (e.g. mesh host workers doing
+    # jax.distributed.initialize with their own XLA platform).
+    isolation: Optional[str] = None  # None | "process"
 
 
 _TASK_KEYS = {f for f in TaskOptions.__dataclass_fields__}

@@ -43,25 +43,219 @@ from ray_tpu.exceptions import (
 
 
 
-class ProcessWorkerHandle:
+class WirePeer:
+    """Shared driver-side state + RPC service for one wire connection.
+
+    Serves the runtime's ownership-bearing API (put/get/wait/submit/actors/
+    streams) to a connected peer — a local worker process
+    (ProcessWorkerHandle) or a remote driver client (head_server.ClientHandle)
+    — with per-peer borrow accounting released on disconnect. This is the
+    L0/L3 service surface of the reference's CoreWorkerService + GCS RPC
+    handlers collapsed onto one framed socket."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self._lock = threading.Lock()
+        # oid bytes -> borrow count held on behalf of this peer
+        self.borrows: dict[bytes, int] = {}
+        # task_id bytes -> driver-side ObjectRefGenerator (peer-submitted
+        # streaming tasks pulled via next_stream_item)
+        self.streams: dict[bytes, Any] = {}
+        self.conn: wire.Connection  # set by subclass before use
+        self.rpc_pool: ThreadPoolExecutor  # set by subclass before use
+
+    # -- borrows -----------------------------------------------------------
+
+    def preborrow(self, oid: ObjectID) -> bytes:
+        """Take a driver-side reference on behalf of this peer (closes the
+        reply/incref race of the borrower protocol)."""
+        raw = oid.binary()
+        with self._lock:
+            self.borrows[raw] = self.borrows.get(raw, 0) + 1
+        self.runtime.refcount.add_local_reference(oid)
+        return raw
+
+    def _drop_all_borrows(self) -> None:
+        with self._lock:
+            borrows, self.borrows = self.borrows, {}
+        for raw, count in borrows.items():
+            for _ in range(count):
+                self.runtime.refcount.remove_local_reference(ObjectID(raw))
+
+    def _handle_incref(self, body: dict) -> None:
+        with self._lock:
+            raw = body["oid"]
+            self.borrows[raw] = self.borrows.get(raw, 0) + 1
+        self.runtime.refcount.add_local_reference(ObjectID(body["oid"]))
+
+    def _handle_decref(self, body: dict) -> None:
+        raw = body["oid"]
+        with self._lock:
+            n = self.borrows.get(raw, 0)
+            if n <= 1:
+                self.borrows.pop(raw, None)
+            else:
+                self.borrows[raw] = n - 1
+        if n >= 1:
+            self.runtime.refcount.remove_local_reference(ObjectID(raw))
+
+    # -- peer-initiated RPCs -----------------------------------------------
+
+    def _handle_rpc(self, body: dict) -> None:
+        msg_id = body["id"]
+        try:
+            result = self._dispatch_rpc(body["method"], body["payload"])
+            reply = {"id": msg_id, "ok": True, "result": result}
+        except BaseException as exc:  # noqa: BLE001 — ship errors to the peer
+            reply = {"id": msg_id, "ok": False, "exc": exc}
+        try:
+            self.conn.send("rpc_reply", reply)
+        except Exception:
+            try:
+                self.conn.send(
+                    "rpc_reply",
+                    {
+                        "id": msg_id,
+                        "ok": False,
+                        "exc": RuntimeError("unserializable RPC reply"),
+                    },
+                )
+            except Exception:
+                pass  # peer is gone
+
+    def _dispatch_rpc(self, method: str, payload: dict):
+        runtime = self.runtime
+        if method == "put":
+            ref = runtime.put(payload["value"])
+            return {"oid": self.preborrow(ref.id)}
+        if method == "get_by_id":
+            oid = ObjectID(payload["oid"])
+            timeout = payload.get("timeout")
+            if not payload.get("force_value"):
+                # Wait for seal WITHOUT materializing: shm-resident objects
+                # are read zero-copy by the worker, so deserializing a copy
+                # here just to throw it away would waste the whole benefit.
+                ready, _ = runtime.store.wait([oid], 1, timeout)
+                if not ready:
+                    from ray_tpu.exceptions import GetTimeoutError
+
+                    raise GetTimeoutError(
+                        f"Get timed out after {timeout}s waiting for {oid}"
+                    )
+                if runtime.store.is_native(oid):
+                    return {"in_native": True}
+                # Forward in-process serialized bytes untouched (no driver-
+                # side decode + frame re-encode); the peer deserializes and
+                # raises ErrorObjects itself.
+                data = runtime.store.get_serialized(oid)
+                if data is not None:
+                    return {"value_pickled": data}
+            value = runtime.get_value(oid, timeout)
+            from ray_tpu._private.runtime import ErrorObject
+
+            if isinstance(value, ErrorObject):
+                value.raise_()
+            return {"value": value}
+        if method == "wait_ids":
+            oids = [ObjectID(raw) for raw in payload["oids"]]
+            ready, remaining = runtime.store.wait(
+                oids,
+                payload.get("num_returns", len(oids)),
+                payload.get("timeout"),
+            )
+            return {
+                "ready": [o.binary() for o in ready],
+                "remaining": [o.binary() for o in remaining],
+            }
+        if method == "submit_task":
+            func = cloudpickle.loads(payload["func"])
+            out = runtime.submit_task(
+                func, payload["args"], payload["kwargs"], **payload["options"]
+            )
+            return self._reply_refs(out, payload["options"])
+        if method == "create_actor":
+            cls = cloudpickle.loads(payload["cls"])
+            actor_id, ref = runtime.create_actor(
+                cls, payload["args"], payload["kwargs"], **payload["options"]
+            )
+            return {
+                "actor_id": actor_id.binary(),
+                "creation_ref": self.preborrow(ref.id),
+            }
+        if method == "submit_actor_task":
+            out = runtime.submit_actor_task(
+                ActorID(payload["actor_id"]),
+                payload["method_name"],
+                payload["args"],
+                payload["kwargs"],
+                **payload["options"],
+            )
+            return self._reply_refs(out, payload["options"])
+        if method == "next_stream_item":
+            gen = self.streams.get(payload["task_id"])
+            if gen is None:
+                return {"done": True, "total": 0}
+            from ray_tpu._private.streaming import _SENTINEL
+
+            ref = gen._stream.next()
+            if ref is _SENTINEL:
+                self.streams.pop(payload["task_id"], None)
+                return {"done": True, "total": gen._stream._total}
+            return {"done": False, "oid": self.preborrow(ref.id)}
+        if method == "named_actor":
+            actor_id = runtime.controller.get_named_actor(
+                payload["name"], payload["namespace"]
+            )
+            return {"actor_id": actor_id.binary()} if actor_id else None
+        if method == "actor_record":
+            record = runtime.controller.get_actor_record(ActorID(payload["actor_id"]))
+            if record is None:
+                return None
+            return {
+                "class_name": record.class_name,
+                "name": record.name,
+                "namespace": record.namespace,
+                "max_restarts": record.max_restarts,
+            }
+        if method == "kill_actor":
+            runtime.kill_actor(
+                ActorID(payload["actor_id"]), no_restart=payload["no_restart"]
+            )
+            return None
+        if method == "cancel":
+            ref = ObjectRef(ObjectID(payload["oid"]))
+            return runtime.cancel(ref, force=payload.get("force", False))
+        raise ValueError(f"unknown RPC method {method!r}")
+
+    def _reply_refs(self, out: list, options: dict) -> dict:
+        from ray_tpu._private.streaming import ObjectRefGenerator
+
+        if out and isinstance(out[0], ObjectRefGenerator):
+            gen = out[0]
+            tid = gen._task_id.binary()
+            self.streams[tid] = gen
+            return {
+                "refs": [self.preborrow(gen._completion_ref.id)],
+                "streaming": True,
+                "task_id": tid,
+            }
+        return {"refs": [self.preborrow(ref.id) for ref in out]}
+
+
+class ProcessWorkerHandle(WirePeer):
     """One worker process: socket, reader thread, in-flight tasks, borrows."""
 
     def __init__(self, engine: "ProcessNodeEngine"):
+        super().__init__(engine.runtime)
         self.engine = engine
-        self.runtime = engine.runtime
+        self.rpc_pool = engine.rpc_pool
         self.actor_id: Optional[ActorID] = None
         self.expected_death = False
         import time as _time
 
         self.last_pong = _time.monotonic()
-        self._lock = threading.Lock()
         # task_id bytes -> (spec, grant)
         self.in_flight: dict[bytes, tuple[TaskSpec, dict]] = {}
-        # oid bytes -> borrow count held on behalf of this worker
-        self.borrows: dict[bytes, int] = {}
-        # task_id bytes -> driver-side ObjectRefGenerator (worker-submitted
-        # streaming tasks pulled via next_stream_item)
-        self.streams: dict[bytes, Any] = {}
         parent_sock, child_sock = socket.socketpair()
         env = os.environ.copy()
         env["RAY_TPU_WORKER_FD"] = str(child_sock.fileno())
@@ -180,24 +374,6 @@ class ProcessWorkerHandle:
                     ),
                 )
 
-    # -- borrows -----------------------------------------------------------
-
-    def preborrow(self, oid: ObjectID) -> bytes:
-        """Take a driver-side reference on behalf of this worker (closes the
-        reply/incref race of the borrower protocol)."""
-        raw = oid.binary()
-        with self._lock:
-            self.borrows[raw] = self.borrows.get(raw, 0) + 1
-        self.runtime.refcount.add_local_reference(oid)
-        return raw
-
-    def _drop_all_borrows(self) -> None:
-        with self._lock:
-            borrows, self.borrows = self.borrows, {}
-        for raw, count in borrows.items():
-            for _ in range(count):
-                self.runtime.refcount.remove_local_reference(ObjectID(raw))
-
     # -- reader ------------------------------------------------------------
 
     def _read_loop(self) -> None:
@@ -301,148 +477,6 @@ class ProcessWorkerHandle:
         if self.actor_id is None and not self.expected_death:
             self.engine.checkin(self)
         self.runtime._on_task_done(spec, self.engine.node, grant, result)
-
-    # -- worker-initiated RPCs ---------------------------------------------
-
-    def _handle_rpc(self, body: dict) -> None:
-        msg_id = body["id"]
-        try:
-            result = self._dispatch_rpc(body["method"], body["payload"])
-            reply = {"id": msg_id, "ok": True, "result": result}
-        except BaseException as exc:  # noqa: BLE001 — ship errors to the worker
-            reply = {"id": msg_id, "ok": False, "exc": exc}
-        try:
-            self.conn.send("rpc_reply", reply)
-        except Exception:
-            try:
-                self.conn.send(
-                    "rpc_reply",
-                    {
-                        "id": msg_id,
-                        "ok": False,
-                        "exc": RuntimeError("unserializable RPC reply"),
-                    },
-                )
-            except Exception:
-                pass  # worker is gone
-
-    def _dispatch_rpc(self, method: str, payload: dict):
-        runtime = self.runtime
-        if method == "put":
-            ref = runtime.put(payload["value"])
-            return {"oid": self.preborrow(ref.id)}
-        if method == "get_by_id":
-            oid = ObjectID(payload["oid"])
-            timeout = payload.get("timeout")
-            if not payload.get("force_value"):
-                # Wait for seal WITHOUT materializing: shm-resident objects
-                # are read zero-copy by the worker, so deserializing a copy
-                # here just to throw it away would waste the whole benefit.
-                ready, _ = runtime.store.wait([oid], 1, timeout)
-                if not ready:
-                    from ray_tpu.exceptions import GetTimeoutError
-
-                    raise GetTimeoutError(
-                        f"Get timed out after {timeout}s waiting for {oid}"
-                    )
-                if runtime.store.is_native(oid):
-                    return {"in_native": True}
-                # Forward in-process serialized bytes untouched (no driver-
-                # side decode + frame re-encode); the worker deserializes and
-                # raises ErrorObjects itself.
-                data = runtime.store.get_serialized(oid)
-                if data is not None:
-                    return {"value_pickled": data}
-            value = runtime.get_value(oid, timeout)
-            from ray_tpu._private.runtime import ErrorObject
-
-            if isinstance(value, ErrorObject):
-                value.raise_()
-            return {"value": value}
-        if method == "wait_ids":
-            oids = [ObjectID(raw) for raw in payload["oids"]]
-            ready, remaining = runtime.store.wait(
-                oids,
-                payload.get("num_returns", len(oids)),
-                payload.get("timeout"),
-            )
-            return {
-                "ready": [o.binary() for o in ready],
-                "remaining": [o.binary() for o in remaining],
-            }
-        if method == "submit_task":
-            func = cloudpickle.loads(payload["func"])
-            out = runtime.submit_task(
-                func, payload["args"], payload["kwargs"], **payload["options"]
-            )
-            return self._reply_refs(out, payload["options"])
-        if method == "create_actor":
-            cls = cloudpickle.loads(payload["cls"])
-            actor_id, ref = runtime.create_actor(
-                cls, payload["args"], payload["kwargs"], **payload["options"]
-            )
-            return {
-                "actor_id": actor_id.binary(),
-                "creation_ref": self.preborrow(ref.id),
-            }
-        if method == "submit_actor_task":
-            out = runtime.submit_actor_task(
-                ActorID(payload["actor_id"]),
-                payload["method_name"],
-                payload["args"],
-                payload["kwargs"],
-                **payload["options"],
-            )
-            return self._reply_refs(out, payload["options"])
-        if method == "next_stream_item":
-            gen = self.streams.get(payload["task_id"])
-            if gen is None:
-                return {"done": True, "total": 0}
-            from ray_tpu._private.streaming import _SENTINEL
-
-            ref = gen._stream.next()
-            if ref is _SENTINEL:
-                self.streams.pop(payload["task_id"], None)
-                return {"done": True, "total": gen._stream._total}
-            return {"done": False, "oid": self.preborrow(ref.id)}
-        if method == "named_actor":
-            actor_id = runtime.controller.get_named_actor(
-                payload["name"], payload["namespace"]
-            )
-            return {"actor_id": actor_id.binary()} if actor_id else None
-        if method == "actor_record":
-            record = runtime.controller.get_actor_record(ActorID(payload["actor_id"]))
-            if record is None:
-                return None
-            return {
-                "class_name": record.class_name,
-                "name": record.name,
-                "namespace": record.namespace,
-                "max_restarts": record.max_restarts,
-            }
-        if method == "kill_actor":
-            runtime.kill_actor(
-                ActorID(payload["actor_id"]), no_restart=payload["no_restart"]
-            )
-            return None
-        if method == "cancel":
-            ref = ObjectRef(ObjectID(payload["oid"]))
-            return runtime.cancel(ref, force=payload.get("force", False))
-        raise ValueError(f"unknown RPC method {method!r}")
-
-    def _reply_refs(self, out: list, options: dict) -> dict:
-        from ray_tpu._private.streaming import ObjectRefGenerator
-
-        if out and isinstance(out[0], ObjectRefGenerator):
-            gen = out[0]
-            tid = gen._task_id.binary()
-            self.streams[tid] = gen
-            return {
-                "refs": [self.preborrow(gen._completion_ref.id)],
-                "streaming": True,
-                "task_id": tid,
-            }
-        return {"refs": [self.preborrow(ref.id) for ref in out]}
 
     # -- death -------------------------------------------------------------
 

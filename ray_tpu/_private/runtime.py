@@ -203,6 +203,8 @@ class Runtime:
         self._put_counter = _Counter()
         self._lock = threading.RLock()
         self.engines: dict[NodeID, NodeEngine] = {}
+        # Per-node companion process engines (per-actor isolation overrides).
+        self._companions: dict[NodeID, Any] = {}
         self.actor_executors: dict[ActorID, ActorExecutor] = {}
         self._actor_buffers: dict[ActorID, list[TaskSpec]] = {}
         self._actor_chains: dict[ActorID, "deque[dict]"] = {}
@@ -235,6 +237,7 @@ class Runtime:
             if self._pending_snapshot:
                 restore_snapshot(self, self._pending_snapshot)
                 self._pending_snapshot = None
+            self._persist_stop = threading.Event()
             self._persist_thread = threading.Thread(
                 target=self._persist_loop, name="gcs-persist", daemon=True
             )
@@ -243,15 +246,10 @@ class Runtime:
     def _persist_loop(self) -> None:
         """Debounced control-plane flush (the reference writes GCS tables to
         Redis asynchronously; a crash loses at most one interval)."""
-        import time as _time
-
         from ray_tpu._private.gcs_storage import build_snapshot
 
         interval = max(0.5, self.config.health_check_period_s)
-        while not self.shutting_down:
-            _time.sleep(interval)
-            if self.shutting_down:
-                return
+        while not self._persist_stop.wait(interval):
             try:
                 self._gcs_storage.save(build_snapshot(self))
             except Exception:
@@ -284,6 +282,9 @@ class Runtime:
         node = self.controller.remove_node(node_id)
         with self._lock:
             engine = self.engines.pop(node_id, None)
+            companion = self._companions.pop(node_id, None)
+        if companion is not None:
+            companion.shutdown()
         if engine is None:
             return
         # Collect this node's actors before shutdown kills them.
@@ -408,12 +409,19 @@ class Runtime:
             return True
         try:
             # Recursively ensure the args exist (their own recovery may
-            # re-execute upstream producers).
+            # re-execute upstream producers). Probe availability WITHOUT
+            # materializing values — dispatch-time arg resolution will do
+            # the one real deserialization.
             for dep in self._dep_ids(spec):
-                try:
-                    self.get_value(dep, timeout=None)
-                except ObjectLostError:
+                if self.store.is_available(dep):
+                    continue
+                if self.store.was_freed(dep):
+                    return False  # explicitly freed: never resurrected
+                if not self._try_recover(dep):
                     return False  # upstream unrecoverable
+                ready, _ = self.store.wait([dep], 1, timeout=300)
+                if not ready:
+                    return False
             for ret in spec.return_ids:
                 self.store.invalidate(ret)
             with self._lock:
@@ -614,6 +622,7 @@ class Runtime:
         max_concurrency: int,
         detached: bool,
         runtime_env: Optional[dict] = None,
+        isolation: Optional[str] = None,
     ) -> tuple[ActorID, ObjectRef]:
         from ray_tpu._private.runtime_env import validate_runtime_env
 
@@ -636,6 +645,7 @@ class Runtime:
             max_concurrency=max_concurrency,
             runtime_env=runtime_env,
             parent_task_id=self.current_task_id(),
+            isolation=isolation,
         )
         spec.compute_return_ids()
         record = ActorRecord(
@@ -820,6 +830,14 @@ class Runtime:
             self.controller.mark_actor_dead(actor_id, reason)
             with self._lock:
                 buffered = self._actor_buffers.pop(actor_id, [])
+                # Release the detached-lifetime pin, or cycling detached
+                # actors (create/kill loops) leaks one creation spec each.
+                creation = self._actor_specs.get(actor_id)
+                if creation is not None and creation.return_ids:
+                    rid = creation.return_ids[0]
+                    self._detached_creation_refs = [
+                        r for r in self._detached_creation_refs if r.id != rid
+                    ]
             for spec in buffered:
                 self._finalize(spec, TaskResult(exc=ActorDiedError(actor_id, reason)))
 
@@ -864,6 +882,10 @@ class Runtime:
                 self._system_failure(record, ObjectLostError(reason="node died"))
             return
         if spec.kind == TaskKind.ACTOR_CREATION:
+            if spec.isolation == "process" and isinstance(engine, NodeEngine):
+                # Per-actor isolation override on a threaded node: host the
+                # actor in this node's companion process engine instead.
+                engine = self._process_companion(node)
             executor = engine.create_actor(spec, grant, self._resolve_args)
             actor_record = self.controller.get_actor_record(spec.actor_id)
             if actor_record is not None:
@@ -877,6 +899,20 @@ class Runtime:
                 executor.submit(queued)
         else:
             engine.execute_task(spec, grant, self._resolve_args)
+
+    def _process_companion(self, node: NodeState):
+        """Lazily-created ProcessNodeEngine sharing a threaded node's
+        NodeState, hosting actors that demanded isolation=\"process\"."""
+        from ray_tpu._private.process_engine import ProcessNodeEngine
+
+        with self._lock:
+            companion = self._companions.get(node.node_id)
+            if companion is None:
+                companion = ProcessNodeEngine(
+                    node, self, on_task_done=self._on_task_done
+                )
+                self._companions[node.node_id] = companion
+        return companion
 
     def _resolve_args(self, spec: TaskSpec) -> tuple[tuple, dict]:
         """Replace top-level ObjectRef args with their values (the dependency
@@ -1093,11 +1129,30 @@ class Runtime:
 
     # ------------------------------------------------------------- shutdown
 
+    def serve_clients(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Expose the control plane over TCP for remote drivers
+        (ray_tpu.init(address=...)). Returns the bound address."""
+        from ray_tpu._private.head_server import HeadServer
+
+        self._head_server = HeadServer(self, host, port)
+        return self._head_server.address
+
     def shutdown(self) -> None:
         global _RUNTIME
+        if getattr(self, "_head_server", None) is not None:
+            try:
+                self._head_server.stop()
+            except Exception:
+                pass
+            self._head_server = None
         if self._gcs_storage is not None:
             from ray_tpu._private.gcs_storage import build_snapshot
 
+            # Stop + join the persist thread BEFORE the final save, so a
+            # racing tick can't overwrite the good snapshot with one taken
+            # mid-teardown (detached actors would read as DEAD and be lost).
+            self._persist_stop.set()
+            self._persist_thread.join(timeout=5.0)
             try:
                 self._gcs_storage.save(build_snapshot(self))
             except Exception:
@@ -1105,8 +1160,9 @@ class Runtime:
         self.shutting_down = True
         self.scheduler.shutdown()
         with self._lock:
-            engines = list(self.engines.values())
+            engines = list(self.engines.values()) + list(self._companions.values())
             self.engines.clear()
+            self._companions.clear()
         for engine in engines:
             engine.shutdown()
         self._background.shutdown(wait=False, cancel_futures=True)

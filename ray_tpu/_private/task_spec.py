@@ -44,6 +44,9 @@ class TaskSpec:
     max_task_retries: int = 0
     runtime_env: Optional[dict] = None
     concurrency_groups: dict[str, int] = field(default_factory=dict)
+    # Per-actor engine override (None = node default, "process" = own OS
+    # process regardless of the runtime's isolation mode).
+    isolation: Optional[str] = None
     # Filled at submission:
     return_ids: list[ObjectID] = field(default_factory=list)
     # Owner context (the submitting task), for lineage:

@@ -133,6 +133,7 @@ class ActorClass:
                 max_concurrency=opts.get("max_concurrency", 1),
                 detached=opts.get("lifetime") == "detached",
                 runtime_env=opts.get("runtime_env"),
+                isolation=opts.get("isolation"),
             )
         except ValueError:
             # Name race: another creator won between our existence check and

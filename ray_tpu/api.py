@@ -46,24 +46,51 @@ def _detect_num_tpu_chips() -> int:
 
 def init(
     *,
+    address: Optional[str] = None,
     num_cpus: Optional[float] = None,
     num_tpus: Optional[float] = None,
     num_gpus: Optional[float] = None,
     resources: Optional[dict[str, float]] = None,
     namespace: str = "default",
     ignore_reinit_error: bool = False,
+    client_server_port: Optional[int] = None,
     _system_config: Optional[dict] = None,
 ) -> Runtime:
-    """Start the runtime with one (head) node.
+    """Start the runtime with one (head) node, or connect to a remote one.
 
-    Unlike the reference this never spawns daemons for the local case — the
-    control plane is in-process. Multi-node tests use
-    ray_tpu.cluster_utils.Cluster to add logical nodes.
+    `address="host:port"` connects this process as a remote driver to a head
+    started with `client_server_port=...` (the ray-client analog,
+    reference: python/ray/util/client/) — the returned proxy serves the full
+    task/actor/object API over the wire protocol.
+
+    Unlike the reference the local case never spawns daemons — the control
+    plane is in-process. Multi-node tests use ray_tpu.cluster_utils.Cluster
+    to add logical nodes.
     """
     if runtime_mod._RUNTIME is not None:
         if ignore_reinit_error:
             return runtime_mod._RUNTIME
         raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+    if address is not None:
+        ignored = {
+            "num_cpus": num_cpus,
+            "num_tpus": num_tpus,
+            "num_gpus": num_gpus,
+            "resources": resources,
+            "client_server_port": client_server_port,
+            "_system_config": _system_config,
+        }
+        bad = [k for k, v in ignored.items() if v is not None]
+        if bad:
+            raise ValueError(
+                f"init(address=...) connects to an existing head; {bad} "
+                "only apply when starting a local runtime"
+            )
+        from ray_tpu._private.client import connect
+
+        proxy = connect(address, namespace=namespace)
+        runtime_mod._RUNTIME = proxy
+        return proxy
     node_resources = dict(resources or {})
     node_resources["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
     tpus = float(num_tpus if num_tpus is not None else _detect_num_tpu_chips())
@@ -71,9 +98,12 @@ def init(
         node_resources["TPU"] = tpus
     if num_gpus:
         node_resources["GPU"] = float(num_gpus)
-    return Runtime(
+    runtime = Runtime(
         resources=node_resources, system_config=_system_config, namespace=namespace
     )
+    if client_server_port is not None:
+        runtime.serve_clients(port=client_server_port)
+    return runtime
 
 
 def is_initialized() -> bool:

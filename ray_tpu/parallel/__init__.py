@@ -4,6 +4,7 @@ from ray_tpu.parallel.mesh import (
     SliceTopology,
     auto_mesh,
 )
+from ray_tpu.parallel.mesh_group import MeshHostWorker, MeshWorkerGroup
 from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from ray_tpu.parallel.sharding import (
     DP_RULES,
@@ -25,7 +26,9 @@ __all__ = [
     "DP_RULES",
     "EP_RULES",
     "FSDP_RULES",
+    "MeshHostWorker",
     "MeshSpec",
+    "MeshWorkerGroup",
     "SP_RULES",
     "STRATEGY_RULES",
     "SliceTopology",

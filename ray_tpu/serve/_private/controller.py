@@ -253,11 +253,17 @@ class ServeControllerActor:
             if ref in ready_set:
                 try:
                     healthy = bool(ray.get(ref, timeout=0))
-                    st.health_timeouts[tag] = 0
+                    if healthy:
+                        st.health_timeouts[tag] = 0
                 except ActorDiedError:
-                    healthy = False
+                    healthy = False  # dead process: immediately fatal
                 except Exception:
-                    healthy = False  # check itself raised: the probe failed
+                    # The check itself raised: count toward the same
+                    # consecutive-failure threshold as timeouts (one
+                    # transient raise must not churn the replica).
+                    misses = st.health_timeouts.get(tag, 0) + 1
+                    st.health_timeouts[tag] = misses
+                    healthy = misses < timeout_threshold
             else:
                 # Timed out: transient a few times, dead past the threshold —
                 # a hung-but-alive replica must eventually be replaced

@@ -114,6 +114,12 @@ def test_autoscaler_scales_up_for_infeasible_task(cluster):
         # Infeasible now (head has no TPU); the monitor provisions a tpu node.
         ref = on_tpu.remote()
         assert ray_tpu.get(ref, timeout=30.0) == "ran-on-tpu"
+        # The task can run the instant add_node registers the new node —
+        # microseconds BEFORE the autoscaler thread reaches its
+        # num_launches increment a few statements later. Poll briefly.
+        deadline = time.time() + 5
+        while time.time() < deadline and monitor.autoscaler.num_launches < 1:
+            time.sleep(0.01)
         assert monitor.autoscaler.num_launches >= 1
     finally:
         monitor.stop()

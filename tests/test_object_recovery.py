@@ -44,6 +44,9 @@ class _MissingNative:
     def get_object(self, oid, track=True):
         return False, None
 
+    def contains(self, oid):
+        return False
+
     def pin(self, oid):
         return False
 
