@@ -1,14 +1,22 @@
-"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+"""Headline benchmarks: the BASELINE.json north-star configs.
 
-BASELINE.json north-star ("Ray Train images/sec/chip (ResNet-50)"). The
-reference publishes no TPU numbers; its stated goal is GPU-parity throughput
-(BASELINE.md "Targets"), so `vs_baseline` is reported against a 1500 img/s/chip
-GPU-parity mark (A100-class ResNet-50 bf16 throughput scaled to one chip).
+Prints one JSON line per config; the LAST line is the headline ResNet-50
+number (same metric/format as round 1, so driver history stays comparable):
 
-Runs the full jitted train step (fwd + bwd + SGD-momentum update, donated
-buffers) on synthetic ImageNet-shaped data sharded over ALL local chips via a
-dp mesh, bf16 compute, averaged over timed steps after compile + warmup.
-Prints ONE JSON line.
+  1. gpt2_125m_train_tokens_per_sec_per_chip  (config #5: LM, flash attention)
+  2. ppo_env_steps_per_sec                    (config #3: RLlib PPO)
+  3. resnet50_train_images_per_sec_per_chip   (config #2: the headline)
+
+The reference publishes no TPU numbers; its stated goal is GPU-parity
+throughput (BASELINE.md "Targets"), so `vs_baseline` compares against
+A100-class single-accelerator marks: 1500 img/s (ResNet-50 bf16),
+150k tokens/s (GPT-2 125M at ~40% MFU), and 10k env-steps/s (PPO CartPole
+with a handful of CPU sampling workers).
+
+MFU context printed with the ResNet line: `measured_matmul_tflops` is THIS
+device's achievable bf16 matmul rate (through the axon tunnel it lands well
+under nameplate), and `pct_of_measured_peak` positions the training step
+against that real ceiling rather than the datasheet.
 """
 
 from __future__ import annotations
@@ -19,21 +27,23 @@ import os
 import time
 
 # The axon TPU plugin force-overrides JAX_PLATFORMS at import; re-apply an
-# explicitly requested platform via the config knob, which wins over both.
+# explicitly requested CPU platform via the config knob, which wins over both.
+# Only for cpu-containing requests: forcing "axon" through the config knob
+# would RESTRICT the registry to axon alone, killing the cpu backend the PPO
+# env runners need for host-side inference.
 _requested_platform = os.environ.get("JAX_PLATFORMS", "")
 
 import jax
 
-if _requested_platform:
+if _requested_platform and "cpu" in _requested_platform.split(","):
     jax.config.update("jax_platforms", _requested_platform)
 
 import jax.numpy as jnp
 import optax
 
-from ray_tpu.models import ResNet50
-from ray_tpu.parallel import MeshSpec, batch_sharding, replicated
-
 GPU_PARITY_IMG_S_PER_CHIP = 1500.0
+GPU_PARITY_TOK_S_PER_CHIP = 150_000.0
+PARITY_PPO_ENV_STEPS_S = 10_000.0
 
 
 def is_tpu(device) -> bool:
@@ -41,9 +51,142 @@ def is_tpu(device) -> bool:
     return device.platform in ("tpu", "axon") or "tpu" in device.device_kind.lower()
 
 
-def main() -> None:
+def _sync(x) -> float:
+    # float() forces a device->host transfer, which is the only reliable full
+    # sync through the axon tunnel (block_until_ready returns early there,
+    # inflating throughput ~50x).
+    return float(x)
+
+
+def bench_gpt2(on_tpu: bool) -> None:
+    """Config #5: GPT-2 125M LM training, tokens/sec/chip."""
+    from ray_tpu.models import GPT, cross_entropy_loss, gpt2_125m
+
     devices = jax.devices()
-    on_tpu = is_tpu(devices[0])
+    n_chips = len(devices)
+    if on_tpu:
+        B, S, warmup, timed = 8 * n_chips, 1024, 3, 10
+        cfg = gpt2_125m(attention_impl="flash", dtype=jnp.bfloat16)
+    else:
+        B, S, warmup, timed = 2, 128, 1, 2
+        cfg = gpt2_125m(
+            attention_impl="reference",
+            dtype=jnp.float32,
+            num_layers=2,
+            max_seq_len=128,
+            vocab_size=1024,
+        )
+    model = GPT(cfg)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+    params = jax.jit(model.init)(key, tokens)
+    tx = optax.adamw(3e-4)
+    opt_state = jax.jit(tx.init)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    tok_s_chip = B * S * timed / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+                "value": round(tok_s_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(tok_s_chip / GPU_PARITY_TOK_S_PER_CHIP, 4),
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_ppo(on_tpu: bool) -> None:
+    """Config #3: RLlib PPO sampling+training throughput, env-steps/sec.
+
+    Envs + policy inference on host CPU threads; the learner's whole
+    epochs x minibatches SGD runs as one jitted scan on the accelerator."""
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    # Logical CPUs: runner actors each request 1 CPU and this box may have a
+    # single physical core (threads timeshare it regardless).
+    ray_tpu.init(num_cpus=max(8, os.cpu_count() or 1), ignore_reinit_error=True)
+    if on_tpu:
+        runners, envs, frag, train_bs, iters = 4, 8, 64, 2048, 5
+    else:
+        runners, envs, frag, train_bs, iters = 2, 4, 32, 256, 2
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=runners,
+            num_envs_per_env_runner=envs,
+            rollout_fragment_length=frag,
+        )
+        .training(train_batch_size=train_bs, minibatch_size=256, num_epochs=4)
+    )
+    algo = config.build()
+    algo.train()  # compile + warmup
+    steps0 = algo._env_steps_total
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        algo.train()
+    dt = time.perf_counter() - t0
+    env_steps_s = (algo._env_steps_total - steps0) / dt
+    import ray_tpu as _rt
+
+    _rt.shutdown()
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_env_steps_per_sec",
+                "value": round(env_steps_s, 1),
+                "unit": "env_steps/sec",
+                "vs_baseline": round(env_steps_s / PARITY_PPO_ENV_STEPS_S, 4),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _measure_matmul_tflops() -> float:
+    n = 8192
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    b = f(a)
+    _sync(b[0, 0])
+    t0 = time.perf_counter()
+    for _ in range(10):
+        b = f(b)
+    _sync(b[0, 0])
+    return 10 * 2 * n**3 / (time.perf_counter() - t0) / 1e12
+
+
+def bench_resnet(on_tpu: bool) -> None:
+    """Config #2 (headline): ResNet-50 training, images/sec/chip.
+
+    Runs the full jitted train step (fwd + bwd + SGD-momentum update, donated
+    buffers) on synthetic ImageNet-shaped data sharded over ALL local chips
+    via a dp mesh, bf16 compute, averaged over timed steps after warmup."""
+    from ray_tpu.models import ResNet50
+    from ray_tpu.parallel import MeshSpec, batch_sharding, replicated
+
+    devices = jax.devices()
     n_chips = len(devices)
     if on_tpu:
         per_chip_batch, image_hw, warmup, timed = 256, 224, 5, 20
@@ -57,7 +200,6 @@ def main() -> None:
     mesh = MeshSpec(dp=-1).build(devices)
     data_shard = batch_sharding(mesh)
     repl = replicated(mesh)
-
     key = jax.random.PRNGKey(0)
 
     # Generate data and params INSIDE jit with explicit out_shardings: nothing
@@ -96,28 +238,44 @@ def main() -> None:
 
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, images, labels)
-    # float() forces a device→host transfer, which is the only reliable full
-    # sync through the axon tunnel (block_until_ready returns early there,
-    # inflating throughput ~50x).
-    float(loss)
+    _sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(timed):
         params, opt_state, loss = step(params, opt_state, images, labels)
-    float(loss)
+    _sync(loss)
     dt = time.perf_counter() - t0
 
     img_s_per_chip = batch * timed / dt / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(img_s_per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(img_s_per_chip / GPU_PARITY_IMG_S_PER_CHIP, 4),
-            }
-        )
-    )
+    line = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_per_chip / GPU_PARITY_IMG_S_PER_CHIP, 4),
+    }
+    if on_tpu:
+        # ResNet-50 fwd+bwd ~= 3 x 4.1 GFLOP/img; position the step against
+        # the device's MEASURED matmul ceiling, not the datasheet number
+        # (scan-batched multi-step was tried and pessimizes 8x on this
+        # stack; per-call chained dispatch overhead is ~6.6ms of ~105ms).
+        matmul_tflops = _measure_matmul_tflops()
+        train_tflops = img_s_per_chip * 3 * 4.1e9 / 1e12
+        line["train_tflops"] = round(train_tflops, 1)
+        line["measured_matmul_tflops"] = round(matmul_tflops, 1)
+        line["pct_of_measured_peak"] = round(100 * train_tflops / matmul_tflops, 1)
+    print(json.dumps(line), flush=True)
+
+
+def main() -> None:
+    on_tpu = is_tpu(jax.devices()[0])
+    for bench in (bench_gpt2, bench_ppo, bench_resnet):
+        try:
+            bench(on_tpu)
+        except Exception as exc:  # one config failing must not hide the rest
+            print(
+                json.dumps({"metric": bench.__name__, "error": repr(exc)[:300]}),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

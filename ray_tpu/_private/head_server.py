@@ -50,6 +50,10 @@ class ClientHandle(WirePeer):
         self._reader = threading.Thread(
             target=self._read_loop, name="client-conn", daemon=True
         )
+
+    def start(self) -> None:
+        """Begin serving; called AFTER the server registered this handle so
+        an instantly-dying connection's forget() can actually remove it."""
         self._reader.start()
 
     def _read_loop(self) -> None:
@@ -114,8 +118,11 @@ class HeadServer:
                 traceback.print_exc()
                 sock.close()
                 continue
+            # Register BEFORE serving: the reader's disconnect path calls
+            # forget(), which must find the handle in the set.
             with self._lock:
                 self._clients.add(handle)
+            handle.start()
 
     def forget(self, handle: ClientHandle) -> None:
         with self._lock:

@@ -54,6 +54,11 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         self.num_cpus_per_learner: float = 1
         self.num_tpus_per_learner: float = 0
+        # multi-agent: {policy_id: RLModuleSpec|None} + agent->policy mapping.
+        # None policies = shared-policy mode (agents flattened into one
+        # module, the common parameter-sharing configuration).
+        self.policies: Optional[dict] = None
+        self.policy_mapping_fn: Optional[Any] = None
         # offline IO: directory to tee sampled rollouts into (JsonWriter)
         self.output: Optional[str] = None
         # debugging / reproducibility
@@ -100,6 +105,28 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise AttributeError(f"Unknown training config {k!r}")
             setattr(self, k, v)
+        return self
+
+    def multi_agent(
+        self,
+        *,
+        policies: Optional[Any] = None,
+        policy_mapping_fn: Optional[Any] = None,
+    ) -> "AlgorithmConfig":
+        """Per-policy multi-agent training (reference: marl_module.py +
+        AlgorithmConfig.multi_agent): `policies` maps policy ids to
+        RLModuleSpecs (None values derive specs from the env's spaces);
+        `policy_mapping_fn(agent_id, **kwargs) -> policy_id` routes each
+        agent. Every policy trains its own parameters with its own
+        optimizer state — independent per-policy optimization."""
+        if policies is not None:
+            self.policies = (
+                dict(policies)
+                if isinstance(policies, dict)
+                else {p: None for p in policies}
+            )
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def learners(
@@ -166,6 +193,36 @@ class AlgorithmConfig:
     def build_learner_group(self, spec: RLModuleSpec) -> LearnerGroup:
         learner_cls = self.get_default_learner_class()
         cfg = self
+
+        if self.policies:
+            from ray_tpu.rllib.core.learner import MultiAgentLearner
+
+            # Per-policy init seeds (same formula as the runner) so policies
+            # start from independently-initialized parameters.
+            specs = {}
+            for offset, (pid, pspec) in enumerate(sorted(self.policies.items())):
+                s = pspec or spec
+                specs[pid] = RLModuleSpec(
+                    observation_space=s.observation_space,
+                    action_space=s.action_space,
+                    model_config=s.model_config,
+                    seed=(s.seed or 0) + 7727 * (offset + 1),
+                )
+            if self.num_learners:
+                raise ValueError(
+                    "per-policy multi-agent training requires a local learner "
+                    "group (num_learners=0) for now"
+                )
+
+            def builder():
+                return MultiAgentLearner(
+                    {
+                        pid: (lambda s=s: learner_cls(s, config=cfg))
+                        for pid, s in specs.items()
+                    }
+                )
+
+            return LearnerGroup(builder, num_learners=0)
 
         def builder():
             return learner_cls(spec, config=cfg)
@@ -273,10 +330,17 @@ class Algorithm(Trainable):
 
     # -- convenience -------------------------------------------------------
 
-    def get_module(self):
-        if self.learner_group.is_local:
-            return self.learner_group.local_learner.module
-        return None
+    def get_module(self, module_id: Optional[str] = None):
+        if not self.learner_group.is_local:
+            return None
+        learner = self.learner_group.local_learner
+        from ray_tpu.rllib.core.learner import MultiAgentLearner
+
+        if isinstance(learner, MultiAgentLearner):
+            if module_id is not None:
+                return learner[module_id].module
+            return {pid: learner[pid].module for pid in learner.keys()}
+        return learner.module
 
     def compute_single_action(self, obs, explore: bool = False):
         """Serving-style single-action inference (reference algorithm.py

@@ -1,16 +1,25 @@
 """IMPALA — asynchronous sampling with V-trace off-policy correction.
 
 Reference: rllib/algorithms/impala/impala.py (:554 config, :687 training_step:
-async sample ObjectRefs → aggregation → learner; learner-thread overlap). The
-re-design keeps the async skeleton as actor-space logic: every remote runner
-always has one sample() in flight; the driver consumes whichever fragments are
-ready (ray_tpu.wait), updates the learner with V-trace (off-policy by one-ish
-weight version, exactly IMPALA's regime), and pushes fresh weights only to the
-runners it just drained — the aggregator-tree behavior at single-learner scale.
+async sample ObjectRefs -> aggregator-actor tree -> learner queue; :697
+aggregation workers; rllib/execution/learner_thread.py). The architecture that
+makes IMPALA IMPALA, in actor space:
+
+  * every remote runner always has one sample() in flight (never idles);
+  * ready fragment REFS route to aggregator actors that concat them into
+    train batches off the driver thread (the aggregator tree — fragments
+    deserialize+concat in parallel, the driver only moves refs);
+  * a dedicated LEARNER THREAD consumes aggregated batches from a bounded
+    queue, overlapping SGD with sampling (the device-feed queue); the queue
+    bound is the backpressure that caps policy lag;
+  * fresh weights broadcast to just-drained runners (V-trace absorbs the
+    one-ish-version staleness — exactly IMPALA's off-policy regime).
 """
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 from typing import Optional
 
 import jax
@@ -38,12 +47,40 @@ class IMPALAConfig(AlgorithmConfig):
         self.num_epochs = 1
         self.minibatch_size = None  # one pass over the whole train batch
         self._compute_gae_on_runner = False  # V-trace runs in the loss
+        # Aggregator-actor tree (reference impala.py:697): 0 = auto (one per
+        # 4 runners); fragments concat into train batches off-driver.
+        self.num_aggregation_workers: int = 0
+        # Bounded device-feed queue between sampling and the learner thread;
+        # the bound caps how far sampling can run ahead (policy lag).
+        self.learner_queue_size: int = 4
 
     def get_default_learner_class(self):
         return IMPALALearner
 
     def get_learner_slice_unit(self) -> int:
         return int(self.rollout_fragment_length or 50)
+
+
+@ray_tpu.remote
+class _AggregatorActor:
+    """Concats rollout fragments into train batches (impala.py:697 tree leaf):
+    the driver passes fragment refs; values deserialize HERE, so N aggregators
+    parallelize the gather that would otherwise serialize on the driver."""
+
+    def __init__(self, train_batch_size: int):
+        self._target = int(train_batch_size)
+        self._buffer: list = []
+        self._count = 0
+
+    def add(self, fragment) -> Optional[SampleBatch]:
+        self._buffer.append(fragment)
+        self._count += fragment.count
+        if self._count >= self._target:
+            out = concat_samples(self._buffer)
+            self._buffer = []
+            self._count = 0
+            return out
+        return None
 
 
 class IMPALALearner(Learner):
@@ -114,7 +151,58 @@ class IMPALA(Algorithm):
 
     def setup(self, config: dict) -> None:
         super().setup(config)
+        cfg = self.algo_config
         self._in_flight: dict[int, object] = {}
+        self._agg_in_flight: list = []  # pending aggregator add() refs
+        self._aggregators: list = []
+        self._agg_cursor = 0
+        n_runners = len(self.env_runner_group.remote_runners())
+        if n_runners:
+            n_agg = int(cfg.num_aggregation_workers) or max(1, n_runners // 4)
+            self._aggregators = [
+                _AggregatorActor.remote(cfg.train_batch_size)
+                for _ in range(n_agg)
+            ]
+        # Learner thread: consumes aggregated batches, overlapping SGD with
+        # sampling (rllib/execution/learner_thread.py).
+        self._queue: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max(1, int(cfg.learner_queue_size))
+        )
+        self._learner_lock = threading.Lock()
+        self._learner_metrics: dict = {}
+        self._learner_updates = 0
+        self._learner_errors = 0
+        self._fresh_weights = self.learner_group.get_weights()
+        self._stopping = False
+        self._learner_thread = threading.Thread(
+            target=self._learner_loop, name="impala-learner", daemon=True
+        )
+        self._learner_thread.start()
+
+    def _learner_loop(self) -> None:
+        while True:
+            batch = self._queue.get()
+            if batch is None:
+                return
+            try:
+                results = self.learner_group.update(batch)
+                weights = self.learner_group.get_weights()
+            except Exception as exc:  # keep the thread alive; surface below
+                with self._learner_lock:
+                    self._learner_metrics = {"learner_error": repr(exc)}
+                    self._learner_errors += 1
+                continue
+            with self._learner_lock:
+                self._learner_metrics = dict(results)
+                self._learner_updates += 1
+                self._fresh_weights = weights
+
+    def _latest_metrics(self) -> dict:
+        with self._learner_lock:
+            out = dict(self._learner_metrics)
+            out["num_learner_updates"] = self._learner_updates
+            out["learner_queue_size"] = self._queue.qsize()
+        return out
 
     def training_step(self) -> dict:
         cfg = self.algo_config
@@ -141,53 +229,120 @@ class IMPALA(Algorithm):
             )
             return dict(results)
 
-        # Keep one sample() in flight per runner.
+        # Loop sampling rounds until the learner thread publishes an update
+        # newer than this step's entry (metrics freshness for the Trainable
+        # contract) — sampling and aggregation CONTINUE during the wait, so
+        # the learner never starves and SGD overlaps collection.
+        import time as _time
+
+        with self._learner_lock:
+            updates_at_entry = self._learner_updates
+            errors_at_entry = self._learner_errors
+        deadline = _time.monotonic() + 120.0
+        enqueued = 0
+        while True:
+            enqueued += self._sampling_round(group, frag)
+            with self._learner_lock:
+                advanced = self._learner_updates > updates_at_entry
+                errored = self._learner_errors > errors_at_entry
+            if advanced or errored or _time.monotonic() > deadline:
+                break
+        out = self._latest_metrics()
+        if errored and not advanced:
+            # A reproducibly failing learner must not silently spin train()
+            # to the deadline forever — propagate to the caller.
+            raise RuntimeError(
+                f"IMPALA learner update failed: {out.get('learner_error')}"
+            )
+        out["num_batches_enqueued"] = enqueued
+        return out
+
+    def _sampling_round(self, group, frag: int) -> int:
+        """Drain ready fragments, route refs through the aggregator tree,
+        enqueue completed train batches; returns batches enqueued."""
+        # Keep one sample() in flight per runner (runners never idle).
         for idx, runner in group.remote_runners().items():
             if idx not in self._in_flight:
                 self._in_flight[idx] = runner.sample.remote(frag)
 
-        batches = []
         drained: list[int] = []
-        count = 0
-        while count < cfg.train_batch_size:
-            refs = {ref: idx for idx, ref in self._in_flight.items()}
-            if not refs:
-                break
-            ready, _ = ray_tpu.wait(list(refs.keys()), num_returns=1, timeout=120.0)
-            if not ready:
-                break
-            for ref in ready:
-                idx = refs[ref]
-                del self._in_flight[idx]
-                try:
-                    batch = ray_tpu.get(ref)
-                except Exception:
-                    group.handle_failures([idx])
-                    continue
-                batches.append(batch)
-                count += batch.count
-                drained.append(idx)
-                # Immediately resubmit so the runner never idles; it still
-                # has its previous weights (V-trace absorbs the staleness).
-                runner = group.remote_runners().get(idx)
-                if runner is not None:
-                    self._in_flight[idx] = runner.sample.remote(frag)
-        if not batches:
-            raise RuntimeError("no rollout fragments received")
-        train_batch = concat_samples(batches)
-        if self._output_writer is not None:
-            self._output_writer.write(train_batch)
-        self._env_steps_total += train_batch.count
-        results = self.learner_group.update(train_batch)
-
-        # Push fresh weights to drained runners only (broadcast-on-consume).
-        group.sync_weights(
-            self.learner_group.get_weights(),
-            global_vars={"timestep": self._env_steps_total},
-            to=sorted(set(drained)),
+        enqueued = 0
+        refs = {ref: idx for idx, ref in self._in_flight.items()}
+        ready, _ = ray_tpu.wait(
+            list(refs.keys()), num_returns=1, timeout=5.0
         )
-        return dict(results)
+        for ref in ready:
+            idx = refs[ref]
+            del self._in_flight[idx]
+            runner = group.remote_runners().get(idx)
+            # Route the fragment REF to an aggregator; a dead runner's
+            # errored ref surfaces when the aggregator add FAILS (arg
+            # resolution cascades the sample error), so the add ref is
+            # tracked with its source runner for failure attribution below.
+            agg = self._aggregators[self._agg_cursor % len(self._aggregators)]
+            self._agg_cursor += 1
+            self._agg_in_flight.append((agg.add.remote(ref), idx))
+            drained.append(idx)
+            if runner is not None:
+                self._in_flight[idx] = runner.sample.remote(frag)
+        # Collect aggregator outputs that completed a batch.
+        if self._agg_in_flight:
+            by_ref = {ref: idx for ref, idx in self._agg_in_flight}
+            done, pending = ray_tpu.wait(
+                list(by_ref.keys()),
+                num_returns=len(by_ref),
+                timeout=0.05,
+            )
+            self._agg_in_flight = [(r, by_ref[r]) for r in pending]
+            for ref in done:
+                try:
+                    train_batch = ray_tpu.get(ref)
+                except Exception:
+                    # The fragment was an error (runner died mid-sample):
+                    # repair/replace the source runner; its stale in-flight
+                    # ref will take the same path and drain out.
+                    group.handle_failures([by_ref[ref]])
+                    drained = [i for i in drained if i != by_ref[ref]]
+                    continue
+                if train_batch is None:
+                    continue
+                self._env_steps_total += train_batch.count
+                if self._output_writer is not None:
+                    self._output_writer.write(train_batch)
+                # Bounded queue = backpressure: sampling throttles when the
+                # learner falls behind, capping policy lag.
+                self._queue.put(train_batch)
+                enqueued += 1
+        # Broadcast-on-consume: just-drained runners get the newest weights
+        # the learner thread has published.
+        if drained:
+            with self._learner_lock:
+                weights = self._fresh_weights
+            group.sync_weights(
+                weights,
+                global_vars={"timestep": self._env_steps_total},
+                to=sorted(set(drained)),
+            )
+        return enqueued
 
     def cleanup(self) -> None:
+        self._stopping = True
+        try:
+            self._queue.put(None, timeout=1.0)
+        except Exception:
+            # Queue full: make room for the poison pill.
+            try:
+                self._queue.get_nowait()
+                self._queue.put_nowait(None)
+            except Exception:
+                pass
+        if getattr(self, "_learner_thread", None) is not None:
+            self._learner_thread.join(timeout=5.0)
+        for agg in self._aggregators:
+            try:
+                ray_tpu.kill(agg)
+            except Exception:
+                pass
+        self._aggregators = []
         self._in_flight = {}
         super().cleanup()

@@ -55,15 +55,44 @@ class EnvRunner:
         if getattr(config, "rl_module_spec", None) is not None:
             spec = config.rl_module_spec
         self.module = spec.build()
-        self._explore_fn = jax.jit(self.module.forward_exploration)
+        # Rollout inference runs on HOST CPU: envs are CPU-bound and per-step
+        # device round trips would dominate (through a TPU tunnel, one sync
+        # RTT per env step collapses sampling 1000x). The learner alone owns
+        # the accelerator — SURVEY.md §7: envs on CPU hosts, learner jit on
+        # TPU. Override with env_runners(sample_device="tpu") for
+        # accelerator-heavy policies.
+        device_kind = getattr(config, "sample_device", "cpu") or "cpu"
+        try:
+            self._device = jax.local_devices(backend=device_kind)[0]
+        except RuntimeError:
+            import warnings
+
+            # Through a remote TPU this costs one sync RTT per env step —
+            # a ~100x sampling cliff. Never degrade silently.
+            warnings.warn(
+                f"env-runner sample device {device_kind!r} unavailable; "
+                "falling back to the default device (per-step device round "
+                "trips will dominate sampling)",
+                RuntimeWarning,
+            )
+            self._device = None
+        self.module.params = jax.device_put(self.module.params, self._device)
+        self._explore_fn = jax.jit(
+            self.module.forward_exploration, device=self._device
+        )
         self._has_vf = getattr(self.module, "has_value_head", True)
         self._vf_fn = (
-            jax.jit(lambda params, obs: self.module.apply(params, obs)[1])
+            jax.jit(
+                lambda params, obs: self.module.apply(params, obs)[1],
+                device=self._device,
+            )
             if self._has_vf
             else None
         )
         seed = (getattr(config, "seed", 0) or 0) * 10007 + worker_index
-        self._rng = jax.random.PRNGKey(seed)
+        with jax.default_device(self._device):
+            self._rng = jax.random.PRNGKey(seed)
+        self._split_fn = jax.jit(jax.random.split, device=self._device)
         self._obs, _ = self.vector_env.reset(seed=seed)
         self._eps_id = np.arange(num_envs, dtype=np.int64) + num_envs * worker_index * 1_000_000
         self._next_eps = self._eps_id.max() + 1
@@ -93,7 +122,7 @@ class EnvRunner:
         B = self.num_envs
         cols: dict[str, list] = defaultdict(list)
         for _ in range(T):
-            self._rng, key = jax.random.split(self._rng)
+            self._rng, key = self._split_fn(self._rng)
             obs = self._obs.astype(np.float32)
             if self.obs_filter is not None:
                 # Rows store FILTERED observations: the learner must see the

@@ -21,7 +21,9 @@ class EnvRunnerGroup:
     def __init__(self, config, local: bool = True):
         from ray_tpu.rllib.evaluation.multi_agent_runner import (
             MultiAgentEnvRunner,
+            PerPolicyMultiAgentRunner,
             RemoteMultiAgentEnvRunner,
+            RemotePerPolicyMultiAgentRunner,
             is_multi_agent_env,
         )
 
@@ -34,8 +36,14 @@ class EnvRunnerGroup:
         # Multi-agent envs sample through the shared-policy runner; the
         # interface is identical so everything downstream is unchanged.
         if is_multi_agent_env(config.env, getattr(config, "env_config", None) or {}):
-            self._runner_cls = MultiAgentEnvRunner
-            self._remote_runner_cls = RemoteMultiAgentEnvRunner
+            if getattr(config, "policies", None):
+                # Per-policy mode: distinct modules routed by
+                # policy_mapping_fn, MultiAgentBatch samples.
+                self._runner_cls = PerPolicyMultiAgentRunner
+                self._remote_runner_cls = RemotePerPolicyMultiAgentRunner
+            else:
+                self._runner_cls = MultiAgentEnvRunner
+                self._remote_runner_cls = RemoteMultiAgentEnvRunner
         else:
             self._runner_cls = EnvRunner
             self._remote_runner_cls = RemoteEnvRunner

@@ -125,6 +125,12 @@ class MultiAgentBatch(dict):
     def agent_steps(self) -> int:
         return sum(b.count for b in self.values())
 
+    @property
+    def count(self) -> int:
+        """Env-step count (the reference counts multi-agent batches by env
+        steps, not agent rows, for train_batch_size accounting)."""
+        return self._env_steps or self.agent_steps()
+
     @staticmethod
     def concat_samples(batches: Sequence["MultiAgentBatch"]) -> "MultiAgentBatch":
         merged: dict[str, list] = {}
