@@ -6,13 +6,17 @@ the full public API works against the remote control plane. Reuses the
 worker-side proxy (worker_main.WorkerProxyRuntime): a client is just a peer
 that never executes tasks.
 
-When the head is on the SAME machine (hostnames match), the client attaches
-the head's shared-memory store and reads large objects zero-copy instead of
-over the socket.
+When the head is on the SAME machine (proven by reading the head's pinned
+random probe object out of the shm segment), the client attaches the head's
+shared-memory store and reads large objects zero-copy instead of over the
+socket. Connections authenticate with a shared-secret token carried in the
+address ("host:port?token=<hex>") or RAY_TPU_CLIENT_TOKEN.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import socket
 import threading
 from typing import Optional
@@ -26,30 +30,31 @@ class ClientCore:
     + identity, without the task-execution half."""
 
     def __init__(self, address: str, timeout: float = 30.0):
+        # Address may carry credentials: "host:port?token=<hex>"; a bare
+        # address falls back to RAY_TPU_CLIENT_TOKEN.
+        address, _, query = address.partition("?")
+        token = ""
+        if query.startswith("token="):
+            token = query[len("token="):]
+        token = token or os.environ.get("RAY_TPU_CLIENT_TOKEN", "")
         host, _, port = address.rpartition(":")
         sock = socket.create_connection((host or "127.0.0.1", int(port)), timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
+        from ray_tpu._private.head_server import send_preamble
+
+        send_preamble(sock, token)  # pre-framing auth, sent unconditionally
         self.conn = wire.Connection(sock)
         msg = self.conn.recv()
         if msg is None or msg[0] != "hello":
-            raise ConnectionError(f"bad handshake from {address}")
+            raise ConnectionError(
+                f"bad handshake from {address} (wrong or missing auth token?)"
+            )
         hello = msg[1]
         self.job_id = JobID(hello["job_id"])
         self.driver_task_id = TaskID(hello["driver_task_id"])
         self.namespace = hello.get("namespace", "default")
-        self.native = None
-        if (
-            hello.get("store_name")
-            and hello.get("hostname") == socket.gethostname()
-        ):
-            try:
-                from ray_tpu._private import native_store
-
-                if native_store.native_store_available():
-                    self.native = native_store.NativeStore(hello["store_name"])
-            except Exception:
-                self.native = None
+        self.native = self._try_attach_store(hello)
         self._rpc_counter = 0
         self._rpc_lock = threading.Lock()
         self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
@@ -58,6 +63,43 @@ class ClientCore:
             target=self._read_loop, name="client-reader", daemon=True
         )
         self._reader.start()
+
+    def _try_attach_store(self, hello: dict):
+        """Zero-copy shm attach, gated on PROOF of same-machine: the segment
+        must exist locally AND the head's pinned random probe object must
+        read back with a matching digest (hostname equality false-positives
+        in containers sharing a hostname)."""
+        if not hello.get("store_name"):
+            return None
+        if os.environ.get("RAY_TPU_CLIENT_SHM_ATTACH", "1") == "0":
+            return None
+        probe_oid = hello.get("store_probe_oid")
+        probe_sha = hello.get("store_probe_sha")
+        if not probe_oid or not probe_sha:
+            return None
+        try:
+            from ray_tpu._private import native_store
+
+            if not native_store.native_store_available():
+                return None
+            store = native_store.NativeStore(hello["store_name"])
+        except Exception:
+            return None
+        try:
+            view = store.get_raw(probe_oid)
+            if view is None:
+                store.close()
+                return None
+            digest = hashlib.sha256(bytes(view)).digest()
+            del view
+            store.release(probe_oid)
+            if digest != probe_sha:
+                store.close()
+                return None
+            return store
+        except Exception:
+            store.close()
+            return None
 
     def rpc(self, method: str, payload: dict):
         with self._rpc_lock:

@@ -14,14 +14,45 @@ Connect client-side: ray_tpu.init(address="host:port")
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
+import secrets
 import socket
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
 
 from ray_tpu._private import wire
 from ray_tpu._private.ids import TaskID
 from ray_tpu._private.process_engine import WirePeer
+
+# Auth preamble: every peer's first bytes are MAGIC + u8 token length +
+# token — checked BEFORE any frame is unpickled, so an unauthenticated peer
+# never reaches cloudpickle.loads (the wire protocol is arbitrary code
+# execution by design; the token is the trust boundary). The preamble is
+# unconditional (length 0 when the peer has no token) so an auth-disabled
+# server and a token-bearing client never misparse each other's streams.
+PREAMBLE_MAGIC = b"RTP1"
+HANDSHAKE_TIMEOUT_S = 10.0
+
+
+def send_preamble(sock: socket.socket, token: str) -> None:
+    raw = token.encode()
+    if len(raw) > 255:
+        raise ValueError("auth token longer than 255 bytes")
+    sock.sendall(PREAMBLE_MAGIC + bytes([len(raw)]) + raw)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    got = b""
+    while len(got) < n:
+        chunk = sock.recv(n - len(got))
+        if not chunk:
+            raise ConnectionError("eof during auth preamble")
+        got += chunk
+    return got
 
 
 class ClientHandle(WirePeer):
@@ -45,6 +76,12 @@ class ClientHandle(WirePeer):
                 "namespace": runtime.namespace,
                 "hostname": socket.gethostname(),
                 "store_name": native.name.decode() if native is not None else None,
+                # Same-machine proof for shm attach: the client must read
+                # this pinned probe object out of the segment and match the
+                # digest — hostname equality alone false-positives in
+                # containers sharing a hostname.
+                "store_probe_oid": server.store_probe_oid,
+                "store_probe_sha": server.store_probe_sha,
             },
         )
         self._reader = threading.Thread(
@@ -83,11 +120,32 @@ class ClientHandle(WirePeer):
 
 
 class HeadServer:
-    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        runtime,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: Optional[str] = None,
+    ):
         self.runtime = runtime
+        # token=None -> generate; token="" -> auth disabled (trusted network,
+        # explicit opt-out only).
+        self.token = secrets.token_hex(16) if token is None else token
         self.rpc_pool = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="head-rpc"
         )
+        self.store_probe_oid: Optional[bytes] = None
+        self.store_probe_sha: Optional[bytes] = None
+        native = runtime._native_store
+        if native is not None:
+            try:
+                probe = os.urandom(64)
+                self.store_probe_oid = os.urandom(28)
+                native.put_raw(self.store_probe_oid, probe)
+                native.pin(self.store_probe_oid)
+                self.store_probe_sha = hashlib.sha256(probe).digest()
+            except Exception:
+                self.store_probe_oid = self.store_probe_sha = None
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -103,6 +161,10 @@ class HeadServer:
 
     @property
     def address(self) -> str:
+        """Connect string for clients; carries the auth token so the address
+        alone is sufficient (and secret) credentials."""
+        if self.token:
+            return f"{self.host}:{self.port}?token={self.token}"
         return f"{self.host}:{self.port}"
 
     def _accept_loop(self) -> None:
@@ -112,17 +174,36 @@ class HeadServer:
             except OSError:
                 return  # listener closed
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                handle = ClientHandle(self, wire.Connection(sock))
-            except Exception:
-                traceback.print_exc()
-                sock.close()
-                continue
-            # Register BEFORE serving: the reader's disconnect path calls
-            # forget(), which must find the handle in the set.
-            with self._lock:
-                self._clients.add(handle)
-            handle.start()
+            # Handshake off-thread: a slow/hostile peer must not block accepts.
+            threading.Thread(
+                target=self._handshake, args=(sock,), daemon=True
+            ).start()
+
+    def _handshake(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(HANDSHAKE_TIMEOUT_S)
+            magic = _recv_exact(sock, len(PREAMBLE_MAGIC))
+            if magic != PREAMBLE_MAGIC:
+                raise ConnectionError("bad preamble magic")
+            (token_len,) = _recv_exact(sock, 1)
+            got = _recv_exact(sock, token_len) if token_len else b""
+            if self.token and not hmac.compare_digest(got, self.token.encode()):
+                raise ConnectionError("bad token")
+            sock.settimeout(None)
+        except Exception:
+            sock.close()
+            return
+        try:
+            handle = ClientHandle(self, wire.Connection(sock))
+        except Exception:
+            traceback.print_exc()
+            sock.close()
+            return
+        # Register BEFORE serving: the reader's disconnect path calls
+        # forget(), which must find the handle in the set.
+        with self._lock:
+            self._clients.add(handle)
+        handle.start()
 
     def forget(self, handle: ClientHandle) -> None:
         with self._lock:

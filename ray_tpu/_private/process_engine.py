@@ -329,6 +329,10 @@ class ProcessWorkerHandle(WirePeer):
         try:
             body = self._wire_body(spec, grant)
         except Exception as exc:
+            # The handle stays healthy on a serialization failure — return it
+            # to the pool, else every unpicklable submission leaks a process.
+            if self.actor_id is None and not self.expected_death:
+                self.engine.checkin(self)
             self.runtime._on_task_done(
                 spec,
                 self.engine.node,
@@ -345,6 +349,8 @@ class ProcessWorkerHandle(WirePeer):
         try:
             payload = cloudpickle.dumps((kind, body), protocol=5)
         except Exception as exc:
+            if self.actor_id is None and not self.expected_death:
+                self.engine.checkin(self)
             self.runtime._on_task_done(
                 spec,
                 self.engine.node,
@@ -661,7 +667,18 @@ class ProcessNodeEngine:
             for handle in workers:
                 if handle.expected_death:
                     continue
-                if now - handle.last_pong > deadline:
+                # A worker mid-task can legitimately starve its recv thread
+                # (long GIL-holding native work: cloudpickle of multi-GB
+                # returns, non-releasing compiles), so a busy worker with a
+                # live OS process gets a much longer staleness deadline —
+                # hung-forever tasks are still eventually killed and retried,
+                # but legitimate long GIL-bound work is not.
+                with handle._lock:
+                    busy = bool(handle.in_flight)
+                worker_deadline = deadline
+                if busy and handle.proc.poll() is None:
+                    worker_deadline = deadline * 10
+                if now - handle.last_pong > worker_deadline:
                     # Unexpected kill: EOF cleanup treats it as a crash.
                     try:
                         handle.proc.kill()

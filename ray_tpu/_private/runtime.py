@@ -1129,12 +1129,19 @@ class Runtime:
 
     # ------------------------------------------------------------- shutdown
 
-    def serve_clients(self, host: str = "127.0.0.1", port: int = 0) -> str:
+    def serve_clients(
+        self, host: str = "127.0.0.1", port: int = 0, token: Optional[str] = None
+    ) -> str:
         """Expose the control plane over TCP for remote drivers
-        (ray_tpu.init(address=...)). Returns the bound address."""
+        (ray_tpu.init(address=...)). Returns the bound address, which carries
+        the auth token ("host:port?token=<hex>"). token=None generates one
+        unless RAY_TPU_CLIENT_TOKEN is set (the cross-machine deployment
+        path: export the same value on every host); token="" disables auth."""
         from ray_tpu._private.head_server import HeadServer
 
-        self._head_server = HeadServer(self, host, port)
+        if token is None:
+            token = os.environ.get("RAY_TPU_CLIENT_TOKEN") or None
+        self._head_server = HeadServer(self, host, port, token=token)
         return self._head_server.address
 
     def shutdown(self) -> None:

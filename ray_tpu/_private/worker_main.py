@@ -212,7 +212,7 @@ class WorkerProxyRuntime:
         if native is not None:
             found, value = native.get_object(oid)
             if found:
-                return value
+                return self._raise_if_error(value)
         # Without a local shm attach, ask the owner for the bytes outright.
         reply = self.rpc(
             "get_by_id",
@@ -221,7 +221,7 @@ class WorkerProxyRuntime:
         if reply.get("in_native"):
             found, value = native.get_object(oid)
             if found:
-                return value
+                return self._raise_if_error(value)
             reply = self.rpc(
                 "get_by_id", {"oid": oid.binary(), "timeout": timeout, "force_value": True}
             )
@@ -229,6 +229,12 @@ class WorkerProxyRuntime:
             value = cloudpickle.loads(reply["value_pickled"])
         else:
             value = reply["value"]
+        return self._raise_if_error(value)
+
+    @staticmethod
+    def _raise_if_error(value: Any) -> Any:
+        """Task-failure ErrorObjects raise as the cause type no matter which
+        path (shm fast path or owner RPC) delivered the bytes."""
         from ray_tpu._private.runtime import ErrorObject
 
         if isinstance(value, ErrorObject):

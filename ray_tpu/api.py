@@ -102,7 +102,12 @@ def init(
         resources=node_resources, system_config=_system_config, namespace=namespace
     )
     if client_server_port is not None:
-        runtime.serve_clients(port=client_server_port)
+        connect_address = runtime.serve_clients(port=client_server_port)
+        # Surface the credentialed connect string — the auto-generated auth
+        # token lives only in this address (or RAY_TPU_CLIENT_TOKEN on both
+        # sides), so remote drivers have no other way to obtain it.
+        print(f"ray_tpu client server listening; connect with "
+              f'ray_tpu.init(address="{connect_address}")')
     return runtime
 
 

@@ -261,18 +261,21 @@ class IMPALA(Algorithm):
         """Drain ready fragments, route refs through the aggregator tree,
         enqueue completed train batches; returns batches enqueued."""
         # Keep one sample() in flight per runner (runners never idle).
+        # Each in-flight ref carries the runner HANDLE it was issued to, so a
+        # failure later surfacing from that ref is attributed to the issuing
+        # runner only — never to a healthy replacement at the same index.
         for idx, runner in group.remote_runners().items():
             if idx not in self._in_flight:
-                self._in_flight[idx] = runner.sample.remote(frag)
+                self._in_flight[idx] = (runner.sample.remote(frag), runner)
 
         drained: list[int] = []
         enqueued = 0
-        refs = {ref: idx for idx, ref in self._in_flight.items()}
+        refs = {ref: (idx, rn) for idx, (ref, rn) in self._in_flight.items()}
         ready, _ = ray_tpu.wait(
             list(refs.keys()), num_returns=1, timeout=5.0
         )
         for ref in ready:
-            idx = refs[ref]
+            idx, source = refs[ref]
             del self._in_flight[idx]
             runner = group.remote_runners().get(idx)
             # Route the fragment REF to an aggregator; a dead runner's
@@ -281,28 +284,41 @@ class IMPALA(Algorithm):
             # tracked with its source runner for failure attribution below.
             agg = self._aggregators[self._agg_cursor % len(self._aggregators)]
             self._agg_cursor += 1
-            self._agg_in_flight.append((agg.add.remote(ref), idx))
+            self._agg_in_flight.append((agg.add.remote(ref), idx, source))
             drained.append(idx)
             if runner is not None:
-                self._in_flight[idx] = runner.sample.remote(frag)
+                self._in_flight[idx] = (runner.sample.remote(frag), runner)
         # Collect aggregator outputs that completed a batch.
         if self._agg_in_flight:
-            by_ref = {ref: idx for ref, idx in self._agg_in_flight}
+            by_ref = {ref: (idx, rn) for ref, idx, rn in self._agg_in_flight}
             done, pending = ray_tpu.wait(
                 list(by_ref.keys()),
                 num_returns=len(by_ref),
                 timeout=0.05,
             )
-            self._agg_in_flight = [(r, by_ref[r]) for r in pending]
+            self._agg_in_flight = [
+                (r, by_ref[r][0], by_ref[r][1]) for r in pending
+            ]
             for ref in done:
                 try:
                     train_batch = ray_tpu.get(ref)
                 except Exception:
-                    # The fragment was an error (runner died mid-sample):
-                    # repair/replace the source runner; its stale in-flight
-                    # ref will take the same path and drain out.
-                    group.handle_failures([by_ref[ref]])
-                    drained = [i for i in drained if i != by_ref[ref]]
+                    # The fragment was an error (runner died mid-sample).
+                    # Kill/replace the source runner only if it is still the
+                    # live runner at that index; stale refs from an already-
+                    # replaced runner drain out without touching the
+                    # replacement (otherwise one death churns every
+                    # successor at this index forever).
+                    idx, source = by_ref[ref]
+                    current = group.remote_runners().get(idx)
+                    if current is not None and current is source:
+                        # Drop the sample ref re-armed on the dead runner so
+                        # the replacement gets a fresh sample() next round.
+                        pending_entry = self._in_flight.get(idx)
+                        if pending_entry is not None and pending_entry[1] is source:
+                            del self._in_flight[idx]
+                        group.handle_failures([idx])
+                    drained = [i for i in drained if i != idx]
                     continue
                 if train_batch is None:
                     continue

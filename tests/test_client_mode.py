@@ -92,6 +92,24 @@ def test_remote_driver_full_api(head):
     assert "CLIENT_OK" in proc.stdout
 
 
+def test_wrong_token_refused(head, monkeypatch):
+    """The head must refuse an unauthenticated peer before unpickling
+    anything it sends (the wire protocol is code execution by design)."""
+    from ray_tpu._private import head_server
+    from ray_tpu._private.client import ClientCore
+
+    monkeypatch.setattr(head_server, "HANDSHAKE_TIMEOUT_S", 1.0)
+    runtime, address = head
+    host_port = address.partition("?")[0]
+    assert "?token=" in address  # credentials ride in the address
+    with pytest.raises(ConnectionError):
+        ClientCore(host_port + "?token=" + "0" * 32, timeout=10.0)
+    # missing token entirely is also refused (server times the peer out)
+    monkeypatch.delenv("RAY_TPU_CLIENT_TOKEN", raising=False)
+    with pytest.raises(ConnectionError):
+        ClientCore(host_port, timeout=10.0)
+
+
 def test_client_disconnect_releases_borrows(head):
     runtime, address = head
     script = textwrap.dedent(
