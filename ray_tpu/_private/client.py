@@ -18,6 +18,7 @@ from __future__ import annotations
 import hashlib
 import os
 import socket
+import sys
 import threading
 from typing import Optional
 
@@ -127,6 +128,16 @@ class ClientCore:
             if msg is None:
                 break
             kind, body = msg
+            if kind == "__decode_error__":
+                # rpc_reply frames carry user values this client may not be
+                # able to unpickle; we can't know which waiter the frame
+                # belonged to, so the only hang-free option is declaring the
+                # connection dead: every waiter fails with ConnectionError.
+                print(
+                    f"client: undecodable frame, closing: {body.get('error')}",
+                    file=sys.stderr,
+                )
+                break
             if kind == "rpc_reply":
                 with self._rpc_lock:
                     waiter = self._rpc_waiters.pop(body["id"], None)

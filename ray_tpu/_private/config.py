@@ -51,6 +51,17 @@ class Config:
     # misdiagnosed as a hang.
     health_check_period_s: float = 3.0
     health_check_failure_threshold: int = 10
+    # Host-memory monitor (reference: common/memory_monitor.h:52 + the
+    # retriable-FIFO worker-killing policy): above the usage threshold,
+    # dispatch is backpressured and one process-backed worker is killed per
+    # tick with an OOM error (its task retries). 0 disables.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_s: float = 1.0
+    # Minimum gap between OOM kills: after sacrificing a worker the monitor
+    # waits this many refresh periods for the reclaimed memory to show up in
+    # /proc before picking another victim (the reference spaces kills the
+    # same way so one pressure spike doesn't massacre the pool).
+    memory_monitor_kill_cooldown_ticks: int = 5
     # Control-plane persistence: when set, KV/job-counter/detached-actor/PG
     # tables are snapshotted here and restored by the next session
     # (reference: gcs_table_storage.h + the Redis `gcs_storage` backend).

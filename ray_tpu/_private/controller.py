@@ -317,6 +317,12 @@ class Controller:
                     extra[pg_resource_name(res, record.pg_id, idx)] = amount
                     wildcard = pg_resource_name(res, record.pg_id, None)
                     extra[wildcard] = extra.get(wildcard, 0.0) + amount
+                # The bundle marker pins zero-resource tasks to the bundle's
+                # node too (reference: the `bundle_group_*` resource added at
+                # commit, placement_group_resource_manager.h).
+                extra[pg_resource_name("bundle", record.pg_id, idx)] = 1000.0
+                wildcard = pg_resource_name("bundle", record.pg_id, None)
+                extra[wildcard] = 1000.0
                 node.add_resources(extra)
                 record.bundle_nodes[idx] = node.node_id
             record.state = PlacementGroupState.CREATED
@@ -346,6 +352,8 @@ class Controller:
                         continue
                     bundle = record.bundles[idx]
                     names = [pg_resource_name(r, pg_id, idx) for r in bundle]
+                    names.append(pg_resource_name("bundle", pg_id, idx))
+                    names.append(pg_resource_name("bundle", pg_id, None))
                     node.remove_resources(names)
                     for res, amount in bundle.items():
                         wildcard = pg_resource_name(res, pg_id, None)

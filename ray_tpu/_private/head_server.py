@@ -19,6 +19,7 @@ import hmac
 import os
 import secrets
 import socket
+import sys
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -29,20 +30,22 @@ from ray_tpu._private.ids import TaskID
 from ray_tpu._private.process_engine import WirePeer
 
 # Auth preamble: every peer's first bytes are MAGIC + u8 token length +
-# token — checked BEFORE any frame is unpickled, so an unauthenticated peer
-# never reaches cloudpickle.loads (the wire protocol is arbitrary code
-# execution by design; the token is the trust boundary). The preamble is
-# unconditional (length 0 when the peer has no token) so an auth-disabled
-# server and a token-bearing client never misparse each other's streams.
+# token + u8 role — checked BEFORE any frame is unpickled, so an
+# unauthenticated peer never reaches cloudpickle.loads (the wire protocol is
+# arbitrary code execution by design; the token is the trust boundary). The
+# preamble is unconditional (length 0 when the peer has no token) so an
+# auth-disabled server and a token-bearing client never misparse each
+# other's streams. Roles: C = remote driver, N = node daemon joining the
+# cluster, O = object-plane fetch connection.
 PREAMBLE_MAGIC = b"RTP1"
 HANDSHAKE_TIMEOUT_S = 10.0
 
 
-def send_preamble(sock: socket.socket, token: str) -> None:
+def send_preamble(sock: socket.socket, token: str, role: bytes = b"C") -> None:
     raw = token.encode()
     if len(raw) > 255:
         raise ValueError("auth token longer than 255 bytes")
-    sock.sendall(PREAMBLE_MAGIC + bytes([len(raw)]) + raw)
+    sock.sendall(PREAMBLE_MAGIC + bytes([len(raw)]) + raw + role)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -103,6 +106,17 @@ class ClientHandle(WirePeer):
             if msg is None:
                 break
             kind, body = msg
+            if kind == "__decode_error__":
+                # Client 'rpc' frames embed user values (put payloads) the
+                # head may not be able to unpickle; no way to know which
+                # call it was, so drop the client — it sees ConnectionError
+                # and its waiters fail instead of hanging.
+                print(
+                    f"head: undecodable client frame, dropping client: "
+                    f"{body.get('error')}",
+                    file=sys.stderr,
+                )
+                break
             try:
                 if kind == "rpc":
                     self.rpc_pool.submit(self._handle_rpc, body)
@@ -189,9 +203,22 @@ class HeadServer:
             got = _recv_exact(sock, token_len) if token_len else b""
             if self.token and not hmac.compare_digest(got, self.token.encode()):
                 raise ConnectionError("bad token")
+            role = _recv_exact(sock, 1)
             sock.settimeout(None)
         except Exception:
             sock.close()
+            return
+        if role == b"N":
+            # A worker node joining the cluster: hand the authenticated
+            # socket to the remote-node layer (the raylet-registration
+            # analog); its first frame is register_node.
+            try:
+                from ray_tpu._private.remote_node import accept_node
+
+                accept_node(self.runtime, wire.Connection(sock))
+            except Exception:
+                traceback.print_exc()
+                sock.close()
             return
         try:
             handle = ClientHandle(self, wire.Connection(sock))

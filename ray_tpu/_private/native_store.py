@@ -99,8 +99,34 @@ def _load_lib():
         lib.tps_poisoned.argtypes = [P]
         lib.tps_destroy.restype = I
         lib.tps_destroy.argtypes = [CP]
+        lib.tps_put_gather.restype = I
+        lib.tps_put_gather.argtypes = [
+            P,
+            CP,
+            ctypes.POINTER(P),
+            ctypes.POINTER(U64),
+            ctypes.POINTER(U64),
+            ctypes.c_int32,
+            U64,
+            ctypes.c_int32,
+        ]
         _lib = lib
         return _lib
+
+
+# Copy parallelism for large puts: the GIL is released inside the C call, so
+# concurrent putters scale, and the copy itself stripes across threads (a
+# single memcpy stream leaves server memory bandwidth on the table).
+_COPY_THREADS = max(2, min(8, (os.cpu_count() or 1)))
+if os.environ.get("RAY_TPU_STORE_COPY_THREADS"):
+    _COPY_THREADS = max(1, int(os.environ["RAY_TPU_STORE_COPY_THREADS"]))
+
+
+def _buffer_address(view: memoryview) -> int:
+    """Zero-copy raw pointer of any contiguous buffer (readonly included)."""
+    import numpy as np
+
+    return np.frombuffer(view, dtype=np.uint8).ctypes.data
 
 
 def native_store_available() -> bool:
@@ -109,6 +135,63 @@ def native_store_available() -> bool:
 
 def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def encode_envelope(value: Any) -> bytes:
+    """Serialize a value into the store's self-contained payload format
+    (header + pickle + out-of-band buffers) on the heap — the cross-node
+    transfer format: a peer daemon put_raw()s these bytes verbatim and its
+    readers get_object() them zero-copy."""
+    buffers: list = []
+    pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    raw_bufs = [b.raw() for b in buffers]
+    header = struct.pack(
+        f"<QQ{len(raw_bufs)}Q",
+        len(pickled),
+        len(raw_bufs),
+        *[len(b) for b in raw_bufs],
+    )
+    total = _pad(len(header)) + _pad(len(pickled))
+    for b in raw_bufs:
+        total += _pad(len(b))
+    out = bytearray(total)
+    view = memoryview(out)
+    view[: len(header)] = header
+    pos = _pad(len(header))
+    view[pos : pos + len(pickled)] = pickled
+    pos += _pad(len(pickled))
+    for b in raw_bufs:
+        view[pos : pos + len(b)] = b
+        pos += _pad(len(b))
+    return bytes(out)
+
+
+def envelope_from_pickle(pickled: bytes) -> bytes:
+    """Wrap plain cloudpickle bytes in the envelope format (zero out-of-band
+    buffers) so they can be put_raw() into a store and get_object()ed back."""
+    header = struct.pack("<QQ", len(pickled), 0)
+    total = _pad(len(header)) + _pad(len(pickled))
+    out = bytearray(total)
+    out[: len(header)] = header
+    pos = _pad(len(header))
+    out[pos : pos + len(pickled)] = pickled
+    return bytes(out)
+
+
+def decode_envelope(view) -> Any:
+    """Deserialize a payload in the store's envelope format (the inverse of
+    encode_envelope / NativeStore.put_object)."""
+    view = memoryview(view).cast("B")
+    pickle_len, n_bufs = struct.unpack_from("<QQ", view, 0)
+    buf_lens = struct.unpack_from(f"<{n_bufs}Q", view, 16)
+    pos = _pad(16 + 8 * n_bufs)
+    pickled = view[pos : pos + pickle_len]
+    pos += _pad(pickle_len)
+    bufs = []
+    for blen in buf_lens:
+        bufs.append(view[pos : pos + blen])
+        pos += _pad(blen)
+    return cloudpickle.loads(pickled, buffers=bufs)
 
 
 class NativeStoreFullError(MemoryError):
@@ -211,23 +294,50 @@ class NativeStore:
 
     def put_object(self, object_id, value: Any) -> int:
         """Serialize with out-of-band buffers into one shm allocation.
-        Returns stored size in bytes."""
+        Returns stored size in bytes.
+
+        The copy into shm happens in ONE tps_put_gather call: the C side
+        copies every piece (header, pickle stream, out-of-band buffers) to
+        its envelope offset with the GIL released and, for large payloads,
+        striped across threads — concurrent putters scale instead of
+        serializing on the interpreter lock."""
         buffers: list = []
         pickled = cloudpickle.dumps(
             value, protocol=5, buffer_callback=buffers.append
         )
-        raw_bufs = [b.raw() for b in buffers]
+        raw_bufs = [memoryview(b.raw()).cast("B") for b in buffers]
+        # Non-contiguous buffers can't be gathered as one pointer+length.
+        raw_bufs = [
+            b if b.contiguous else memoryview(bytes(b)) for b in raw_bufs
+        ]
         header = struct.pack(
             f"<QQ{len(raw_bufs)}Q",
             len(pickled),
             len(raw_bufs),
-            *[len(b) for b in raw_bufs],
+            *[b.nbytes for b in raw_bufs],
         )
-        total = _pad(len(header)) + _pad(len(pickled))
-        for b in raw_bufs:
-            total += _pad(len(b))
-        out = ctypes.c_void_p()
-        rc = self._lib.tps_create(self._handle, self._key(object_id), total, ctypes.byref(out))
+        pieces = [memoryview(header), memoryview(pickled)] + raw_bufs
+        n = len(pieces)
+        offsets = (ctypes.c_uint64 * n)()
+        lens = (ctypes.c_uint64 * n)()
+        ptrs = (ctypes.c_void_p * n)()
+        pos = 0
+        for i, piece in enumerate(pieces):
+            offsets[i] = pos
+            lens[i] = piece.nbytes
+            ptrs[i] = _buffer_address(piece)
+            pos += _pad(piece.nbytes)
+        total = pos
+        rc = self._lib.tps_put_gather(
+            self._handle,
+            self._key(object_id),
+            ptrs,
+            lens,
+            offsets,
+            n,
+            total,
+            _COPY_THREADS,
+        )
         if rc == -1:  # already stored (task retry reseal) — idempotent
             return total
         # -2 full / -3 index full / -4 poisoned / -5 old payload mid-deferred-
@@ -235,18 +345,7 @@ class NativeStore:
         if rc in (-2, -3, -4, -5):
             raise NativeStoreFullError(f"native store unavailable ({total} bytes)")
         if rc != 0:
-            raise RuntimeError(f"tps_create failed rc={rc}")
-        dest = (ctypes.c_uint8 * total).from_address(out.value)
-        view = memoryview(dest).cast("B")
-        pos = 0
-        view[pos : pos + len(header)] = header
-        pos = _pad(len(header))
-        view[pos : pos + len(pickled)] = pickled
-        pos += _pad(len(pickled))
-        for b in raw_bufs:
-            view[pos : pos + len(b)] = b
-            pos += _pad(len(b))
-        self._lib.tps_seal(self._handle, self._key(object_id))
+            raise RuntimeError(f"tps_put_gather failed rc={rc}")
         return total
 
     def get_object(self, object_id, track: bool = True) -> tuple:
@@ -256,17 +355,7 @@ class NativeStore:
         view = self.get_raw(object_id, track=track)
         if view is None:
             return False, None
-        pickle_len, n_bufs = struct.unpack_from("<QQ", view, 0)
-        buf_lens = struct.unpack_from(f"<{n_bufs}Q", view, 16)
-        pos = _pad(16 + 8 * n_bufs)
-        pickled = view[pos : pos + pickle_len]
-        pos += _pad(pickle_len)
-        bufs = []
-        for blen in buf_lens:
-            bufs.append(view[pos : pos + blen])
-            pos += _pad(blen)
-        value = cloudpickle.loads(pickled, buffers=bufs)
-        return True, value
+        return True, decode_envelope(view)
 
     # -- stats / lifecycle -------------------------------------------------
 

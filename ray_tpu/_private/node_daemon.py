@@ -1,0 +1,500 @@
+"""Worker-node daemon: joins a head over TCP and hosts local workers.
+
+The raylet analog (reference: raylet/main.cc + services.py:1353 `ray start`
+plumbing): one daemon per machine. It owns
+
+  * a node-local shared-memory store its workers attach zero-copy,
+  * an object server exposing those bytes to peers (object_plane.py),
+  * the worker processes (worker_main.py over inherited socketpairs),
+
+and muxes worker frames over one authenticated TCP connection to the head
+(remote_node.py documents the frame protocol). All ownership/scheduling
+state stays on the head; the daemon is deliberately dumb — spawn, route,
+serve bytes, report deaths.
+
+The daemon intercepts exactly one worker RPC: `get_by_id`. Reads hit the
+node-local store first (zero-copy); misses trigger an owner-directed
+location lookup on the head and a direct pull from the holding node's
+object server, after which the bytes are cached in the local store so every
+other worker on this node reads them zero-copy (reference: PullManager
+request dedup, object_manager/pull_manager.h).
+
+Start:  ray-tpu start --address='head:port?token=...' [--num-cpus N ...]
+   or:  python -m ray_tpu._private.node_daemon --address=...
+Stops when the head connection drops (fate-sharing, both directions).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import cloudpickle
+
+from ray_tpu._private import wire
+from ray_tpu._private.object_plane import (
+    TAG_ENVELOPE,
+    TAG_PICKLE,
+    ObjectFetcher,
+    ObjectServer,
+)
+
+
+class DaemonWorker:
+    """One local worker process: spawn, forward frames, report death."""
+
+    def __init__(self, daemon: "NodeDaemon", wid: int):
+        self.daemon = daemon
+        self.wid = wid
+        self.alive = True
+        parent_sock, child_sock = socket.socketpair()
+        env = os.environ.copy()
+        env["RAY_TPU_WORKER_FD"] = str(child_sock.fileno())
+        env["RAY_TPU_IS_WORKER"] = "1"
+        platform = daemon.welcome.get("worker_jax_platform")
+        if platform:
+            env["JAX_PLATFORMS"] = platform
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            pass_fds=[child_sock.fileno()],
+            env=env,
+        )
+        child_sock.close()
+        self.conn = wire.Connection(parent_sock)
+        self.conn.send(
+            "hello",
+            {
+                "store_name": daemon.store.name.decode()
+                if daemon.store is not None
+                else None,
+                "node_id": daemon.welcome["node_id"],
+                "job_id": daemon.welcome["job_id"],
+                "driver_task_id": daemon.welcome["driver_task_id"],
+                "namespace": daemon.welcome.get("namespace", "default"),
+                "native_threshold": daemon.welcome.get("native_threshold", 0)
+                if daemon.store is not None
+                else 0,
+                # Daemon's own path + the driver's import roots forwarded in
+                # node_welcome (functions pickled by reference must resolve
+                # on this machine's workers too).
+                "sys_path": list(
+                    dict.fromkeys(
+                        [p for p in sys.path if p]
+                        + list(daemon.welcome.get("sys_path", ()))
+                    )
+                ),
+            },
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"dworker-{wid}", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                traceback.print_exc()
+                msg = None
+            if msg is None:
+                break
+            kind, body = msg
+            try:
+                if kind == "rpc" and body.get("method") == "get_by_id":
+                    # The one daemon-intercepted RPC: local-store fast path +
+                    # cross-node pull. Off-thread so a blocking wait-for-seal
+                    # doesn't wedge this worker's frame forwarding.
+                    self.daemon.rpc_pool.submit(self.daemon.serve_get, self, body)
+                elif kind == "pong":
+                    pass  # local liveness only; EOF is the real signal
+                else:
+                    self.daemon.to_head("wf", {"wid": self.wid, "k": kind, "b": body})
+            except Exception:
+                traceback.print_exc()
+        self.alive = False
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+        self.daemon.on_worker_exit(self)
+
+    def send_frame_bytes(self, payload: bytes) -> None:
+        self.conn.send_bytes(payload)
+
+    def reply(self, msg_id: int, *, ok: bool, result=None, exc=None) -> None:
+        body = {"id": msg_id, "ok": ok}
+        if ok:
+            body["result"] = result
+        else:
+            body["exc"] = exc
+        try:
+            self.conn.send("rpc_reply", body)
+        except Exception:
+            pass
+
+    def kill(self) -> None:
+        self.alive = False
+        try:
+            self.conn.send("kill", {})
+        except Exception:
+            pass
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+        self.conn.close()
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        address: str,
+        resources: Optional[dict] = None,
+        labels: Optional[dict] = None,
+        object_store_memory: Optional[int] = None,
+    ):
+        address, _, query = address.partition("?")
+        token = ""
+        if query.startswith("token="):
+            token = query[len("token=") :]
+        token = token or os.environ.get("RAY_TPU_CLIENT_TOKEN", "")
+        self.token = token
+        host, _, port = address.rpartition(":")
+        self.head_host = host or "127.0.0.1"
+
+        # Node-local store (workers attach zero-copy; peers pull via the
+        # object server). Sized like the head's default budget.
+        self.store = None
+        try:
+            from ray_tpu._private import native_store
+
+            if native_store.native_store_available():
+                capacity = object_store_memory or self._default_budget()
+                self.store = native_store.NativeStore(
+                    f"/ray_tpu_node_{os.getpid()}", capacity=capacity
+                )
+        except Exception:
+            self.store = None
+
+        self.object_server = None
+        if self.store is not None:
+            # Bind the interface this node is reachable at from the cluster
+            # (loopback for a localhost cluster — don't expose object bytes
+            # wider than the control plane's reach).
+            self.object_server = ObjectServer(
+                self._serve_bytes, token, host=self._advertise_host()
+            )
+        self.fetcher = ObjectFetcher(token)
+        self.rpc_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="daemon-rpc"
+        )
+
+        sock = socket.create_connection((self.head_host, int(port)), 30.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from ray_tpu._private.head_server import send_preamble
+
+        send_preamble(sock, token, role=b"N")
+        self.conn = wire.Connection(sock)
+        self._send_lock = threading.Lock()
+
+        if resources is None:
+            resources = {}
+        resources.setdefault("CPU", float(os.cpu_count() or 1))
+        self.conn.send(
+            "register_node",
+            {
+                "resources": resources,
+                "labels": labels or {},
+                "hostname": socket.gethostname(),
+                "pid": os.getpid(),
+                "object_addr": [
+                    self._advertise_host(),
+                    self.object_server.port,
+                ]
+                if self.object_server is not None
+                else None,
+                "store_name": self.store.name.decode()
+                if self.store is not None
+                else None,
+            },
+        )
+        msg = self.conn.recv()
+        if msg is None or msg[0] != "node_welcome":
+            raise ConnectionError("head rejected node registration")
+        self.welcome = msg[1]
+        self.node_id = self.welcome["node_id"]
+        # Adopt the driver's import roots: the daemon decodes every worker
+        # frame before muxing it to the head, so values pickled by reference
+        # to driver-side modules must resolve HERE too (nonexistent paths on
+        # this machine are skipped by the import system).
+        for path in self.welcome.get("sys_path", ()):
+            if path not in sys.path:
+                sys.path.append(path)
+
+        self._lock = threading.Lock()
+        self.workers: dict[int, DaemonWorker] = {}
+        # In-flight cross-node pulls deduped per oid (PullManager semantics).
+        self._pulls: dict[bytes, threading.Event] = {}
+        self._rpc_counter = 0
+        self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
+
+    @staticmethod
+    def _default_budget() -> int:
+        # Same sizing rule as the head (30% of RAM, 200 GB cap —
+        # _private/ray_constants.py:51-53 in the reference).
+        try:
+            pages = os.sysconf("SC_PHYS_PAGES")
+            page = os.sysconf("SC_PAGE_SIZE")
+            return min(int(pages * page * 0.3), 200 * 1024**3)
+        except (ValueError, OSError):
+            return 1 << 30
+
+    def _advertise_host(self) -> str:
+        """The address peers reach this node's object server at: the local
+        interface used to reach the head (works on localhost and real LANs)."""
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect((self.head_host, 1))
+            addr = probe.getsockname()[0]
+            probe.close()
+            return addr
+        except OSError:
+            return "127.0.0.1"
+
+    # -- object plane -------------------------------------------------------
+
+    def _serve_bytes(self, oid_bytes: bytes):
+        view = self.store.get_raw(oid_bytes)
+        if view is None:
+            return None
+        try:
+            data = bytes(view)
+        finally:
+            del view
+            self.store.release(oid_bytes)
+        return (TAG_ENVELOPE, data)
+
+    def serve_get(self, worker: DaemonWorker, body: dict) -> None:
+        """Intercepted get_by_id from a local worker."""
+        payload = body["payload"]
+        oid = payload["oid"]
+        msg_id = body["id"]
+        try:
+            if self.store is not None and self.store.contains(oid):
+                worker.reply(msg_id, ok=True, result={"in_native": True})
+                return
+            if self.store is not None and self._pull_into_store(
+                oid, payload.get("timeout")
+            ):
+                worker.reply(msg_id, ok=True, result={"in_native": True})
+                return
+        except Exception:
+            traceback.print_exc()
+        # Fallback: forward the original RPC to the head (value rides the
+        # control connection — correct for small/local-only values).
+        self.to_head("wf", {"wid": worker.wid, "k": "rpc", "b": body})
+
+    def _pull_into_store(self, oid: bytes, timeout) -> bool:
+        """Locate via the head, pull from the holding node's object server,
+        seal into the local store. Returns False when no peer holds bytes
+        (head-local small values fall back to the control-plane path)."""
+        with self._lock:
+            event = self._pulls.get(oid)
+            leader = event is None
+            if leader:
+                event = self._pulls[oid] = threading.Event()
+        if not leader:
+            event.wait(timeout=300)
+            return self.store.contains(oid)
+        try:
+            reply = self.head_rpc(
+                "locate_object", {"oid": oid, "timeout": timeout}
+            )
+            addr = reply.get("addr")
+            if not addr:
+                return False
+            fetched = self.fetcher.fetch((addr[0], addr[1]), oid)
+            if fetched is None:
+                return False
+            tag, data = fetched
+            if tag == TAG_PICKLE:
+                from ray_tpu._private.native_store import envelope_from_pickle
+
+                data = envelope_from_pickle(data)
+            self.store.put_raw(oid, data)
+            return True
+        except Exception:
+            return False
+        finally:
+            with self._lock:
+                self._pulls.pop(oid, None)
+            event.set()
+
+    # -- head RPC (daemon-level) -------------------------------------------
+
+    def head_rpc(self, method: str, payload: dict):
+        with self._lock:
+            self._rpc_counter += 1
+            msg_id = self._rpc_counter
+            event = threading.Event()
+            slot: dict = {}
+            self._rpc_waiters[msg_id] = (event, slot)
+        self.to_head("rpc", {"id": msg_id, "method": method, "payload": payload})
+        event.wait(timeout=300)
+        if slot.get("dead") or not slot:
+            raise ConnectionError("head connection lost")
+        if slot.get("ok"):
+            return slot["result"]
+        raise slot["exc"]
+
+    def to_head(self, kind: str, body: dict) -> None:
+        self.conn.send(kind, body)
+
+    # -- worker lifecycle ---------------------------------------------------
+
+    def on_worker_exit(self, worker: DaemonWorker) -> None:
+        with self._lock:
+            existing = self.workers.get(worker.wid)
+            if existing is worker:
+                del self.workers[worker.wid]
+            else:
+                return
+        try:
+            self.to_head("worker_exit", {"wid": worker.wid})
+        except Exception:
+            pass
+
+    # -- main loop ----------------------------------------------------------
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                traceback.print_exc()
+                msg = None
+            if msg is None:
+                break  # head died or kicked us: fate-share
+            kind, body = msg
+            if kind == "__decode_error__":
+                # Head->daemon frames carry only system types; corruption
+                # here means the control stream can't be trusted: fate-share.
+                print(
+                    f"daemon: undecodable head frame, exiting: "
+                    f"{body.get('error')}",
+                    file=sys.stderr,
+                )
+                break
+            try:
+                self._handle_frame(kind, body)
+            except Exception:
+                traceback.print_exc()
+        self.shutdown()
+
+    def _handle_frame(self, kind: str, body: dict) -> None:
+        if kind == "tw":
+            with self._lock:
+                worker = self.workers.get(body["wid"])
+            if worker is not None:
+                worker.send_frame_bytes(body["p"])
+        elif kind == "spawn_worker":
+            worker = DaemonWorker(self, body["wid"])
+            with self._lock:
+                self.workers[body["wid"]] = worker
+        elif kind == "kill_worker":
+            with self._lock:
+                worker = self.workers.pop(body["wid"], None)
+            if worker is not None:
+                worker.kill()
+        elif kind == "delete_objects":
+            if self.store is not None:
+                for oid in body["oids"]:
+                    try:
+                        self.store.delete(oid)
+                    except Exception:
+                        pass
+        elif kind == "rpc_reply":
+            with self._lock:
+                waiter = self._rpc_waiters.pop(body["id"], None)
+            if waiter is not None:
+                event, slot = waiter
+                slot.update(body)
+                event.set()
+        elif kind == "ping":
+            try:
+                self.to_head("pong", {"id": body.get("id")})
+            except Exception:
+                pass
+        elif kind == "shutdown":
+            raise SystemExit(0)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+        for worker in workers:
+            worker.kill()
+        if self.object_server is not None:
+            self.object_server.stop()
+        self.fetcher.close()
+        if self.store is not None:
+            try:
+                self.store.destroy()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="Join a ray_tpu cluster as a worker node"
+    )
+    parser.add_argument(
+        "--address",
+        required=True,
+        help="head connect string, host:port?token=... (printed by the head)",
+    )
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-gpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument(
+        "--resources", default=None, help='extra resources as JSON, e.g. \'{"mem": 4}\''
+    )
+    parser.add_argument("--labels", default=None, help="node labels as JSON")
+    parser.add_argument("--object-store-memory", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    if args.num_gpus:
+        resources["GPU"] = args.num_gpus
+    if args.num_tpus:
+        resources["TPU"] = args.num_tpus
+    labels = json.loads(args.labels) if args.labels else {}
+
+    daemon = NodeDaemon(
+        args.address,
+        resources=resources,
+        labels=labels,
+        object_store_memory=args.object_store_memory,
+    )
+    print(f"node daemon up: node_id={daemon.node_id} pid={os.getpid()}", flush=True)
+    try:
+        daemon.run_forever()
+    except SystemExit:
+        daemon.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,7 @@ class _Entry:
         "in_native",
         "spilled_uri",
         "nested_refs",
+        "remote_node",
     )
 
     def __init__(self):
@@ -84,6 +85,10 @@ class _Entry:
         # ObjectRef handles serialized inside this value (borrows): held for
         # the entry's lifetime so the inner objects can't be collected.
         self.nested_refs: list | None = None
+        # Bytes live in a remote node's local store (reference: the object
+        # directory, ownership_based_object_directory.h — the owner records
+        # locations, readers pull). None = bytes are local (or not sealed).
+        self.remote_node = None
 
 
 class InProcessStore:
@@ -113,9 +118,17 @@ class InProcessStore:
         # Objects the reference counter still holds references to may not be
         # evicted; the runtime installs this callback.
         self._pinned_check: Callable[[ObjectID], bool] = lambda oid: True
+        self._remote_fetch = None  # installed via set_remote_fetch
 
     def set_pinned_check(self, fn: Callable[[ObjectID], bool]) -> None:
         self._pinned_check = fn
+
+    def set_remote_fetch(self, fn) -> None:
+        """Install the cross-node pull: fn(object_id, node_id) returns the
+        materialized value after (optionally) caching bytes locally, or
+        raises ObjectLostError. Installed by the runtime when remote nodes
+        exist (reference: PullManager, object_manager/pull_manager.h)."""
+        self._remote_fetch = fn
 
     # -- write path ---------------------------------------------------------
 
@@ -267,6 +280,90 @@ class InProcessStore:
                 cb()
         return True
 
+    def seal_remote(
+        self,
+        object_id: ObjectID,
+        node_id,
+        size: int,
+        nested_refs: list | None = None,
+    ) -> None:
+        """Record that a worker on a remote node produced+sealed this object
+        into that node's local store: the owner keeps the location, not the
+        bytes. Reads pull through the remote-fetch hook on demand."""
+        fire = False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            if entry.sealed:
+                return  # idempotent reseal on retry: keep the first copy
+            entry.value = None
+            entry.size = 0  # bytes accounted by the remote node's store
+            entry.sealed = True
+            entry.freed = False
+            entry.in_native = False
+            entry.remote_node = node_id
+            entry.nested_refs = nested_refs
+            entry.last_access = time.monotonic()
+            entry.event.set()
+            callbacks, entry.callbacks = entry.callbacks, []
+            fire = True
+        if fire:
+            for cb in callbacks:
+                cb()
+
+    def location_of(self, object_id: ObjectID):
+        """The remote node holding this sealed object's bytes, or None when
+        the bytes are local/unsealed (the owner-directed location lookup)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed:
+                return None
+            return entry.remote_node
+
+    def adopt_fetched(
+        self, object_id: ObjectID, value: Any, pickled: bytes | None = None
+    ) -> None:
+        """Cache a remotely-fetched object's bytes locally so later reads
+        skip the network: converts a remote-located entry in place. Subject
+        to the same budget/eviction as seal — pulls must not grow memory
+        past the budget."""
+        dropped: list = []
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.remote_node is None:
+                return
+            if pickled is not None:
+                size = len(pickled)
+                new_value: Any = _Pickled(pickled)
+            else:
+                size = _sizeof(value)
+                new_value = value
+            if self._budget is not None and self._used + size > self._budget:
+                self._evict_locked(self._used + size - self._budget, dropped)
+            entry.value = new_value
+            entry.size = size
+            entry.last_access = time.monotonic()
+            self._used += size
+            entry.remote_node = None
+        del dropped  # nested_refs GC outside the lock
+
+    def adopt_fetched_native(self, object_id: ObjectID) -> bool:
+        """Flip a remote-located entry to shm-resident after its envelope
+        bytes were written into the local native store. Returns False if the
+        pin failed (raced an eviction)."""
+        if self._native is None or not self._native.pin(object_id):
+            return False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.remote_node is None:
+                self._native.release(object_id)
+                return True
+            entry.in_native = True
+            entry.remote_node = None
+        return True
+
     def invalidate(self, object_id: ObjectID) -> None:
         """Reset a lost object's entry to the unsealed state so the lineage
         re-execution's reseal can land and readers re-block on the event
@@ -289,6 +386,7 @@ class InProcessStore:
             entry.in_native = False
             entry.spilled_uri = None
             entry.nested_refs = None
+            entry.remote_node = None
             entry.event.clear()
         if was_native and self._native is not None:
             # Drop the owner pin so the shm payload doesn't leak; with reader
@@ -311,6 +409,8 @@ class InProcessStore:
             return _os.path.exists(spilled_uri)
         if in_native:
             return self._native is not None and self._native.contains(object_id)
+        # Remote-located entries count as available while the node is up;
+        # a failed pull surfaces as ObjectLostError at read time.
         return True
 
     def was_freed(self, object_id: ObjectID) -> bool:
@@ -363,8 +463,20 @@ class InProcessStore:
                 entry.last_access = time.monotonic()
                 spilled_uri = entry.spilled_uri
                 in_native = entry.in_native
+                remote_node = entry.remote_node
                 value = entry.value
                 break
+        if remote_node is not None:
+            # Pull through the cross-node hook (which caches locally and may
+            # flip the entry to in_native/_Pickled; raises ObjectLostError on
+            # a dead node / evicted copy, triggering lineage recovery).
+            if self._remote_fetch is None:
+                raise ObjectLostError(
+                    object_id,
+                    f"Object {object_id} lives on node {remote_node} but no "
+                    "remote fetch path is installed",
+                )
+            return self._remote_fetch(object_id, remote_node)
         if spilled_uri is None and not in_native:
             if not isinstance(value, _Pickled):
                 return value
@@ -494,6 +606,7 @@ class InProcessStore:
                     entry.value = None
                     entry.freed = True
                     entry.nested_refs = None
+                    entry.remote_node = None
                     entry.event.set()
                     fired.extend(entry.callbacks)
                     entry.callbacks = []
@@ -540,6 +653,7 @@ class InProcessStore:
                 and not entry.freed
                 and entry.spilled_uri is None  # spilled: no resident bytes
                 and not entry.in_native  # shm bytes: governed by shm's own LRU
+                and entry.remote_node is None  # bytes live on a remote node
                 and not self._pinned_check(oid)
             ),
             key=lambda item: item[0],
@@ -574,6 +688,7 @@ class InProcessStore:
                     if entry.sealed
                     and not entry.freed
                     and not entry.in_native
+                    and entry.remote_node is None
                     and entry.spilled_uri is None
                 ),
                 key=lambda item: item[0],

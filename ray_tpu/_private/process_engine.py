@@ -107,7 +107,17 @@ class WirePeer:
             result = self._dispatch_rpc(body["method"], body["payload"])
             reply = {"id": msg_id, "ok": True, "result": result}
         except BaseException as exc:  # noqa: BLE001 — ship errors to the peer
-            reply = {"id": msg_id, "ok": False, "exc": exc}
+            # Exceptions are user data: pre-pickled so a class the worker
+            # can't unpickle degrades to an RPC error there instead of
+            # corrupting the frame envelope (the worker fate-shares on
+            # envelope corruption).
+            try:
+                exc_bytes = cloudpickle.dumps(exc, protocol=5)
+            except Exception:
+                exc_bytes = cloudpickle.dumps(
+                    RuntimeError(f"unserializable RPC error: {exc!r}"), protocol=5
+                )
+            reply = {"id": msg_id, "ok": False, "exc_pickled": exc_bytes}
         try:
             self.conn.send("rpc_reply", reply)
         except Exception:
@@ -155,7 +165,10 @@ class WirePeer:
 
             if isinstance(value, ErrorObject):
                 value.raise_()
-            return {"value": value}
+            # Pre-pickled: rpc_reply frames must stay envelope-safe (raw
+            # user values in the frame would make a worker-side unpickle
+            # failure look like wire corruption).
+            return {"value_pickled": cloudpickle.dumps(value, protocol=5)}
         if method == "wait_ids":
             oids = [ObjectID(raw) for raw in payload["oids"]]
             ready, remaining = runtime.store.wait(
@@ -242,20 +255,62 @@ class WirePeer:
         return {"refs": [self.preborrow(ref.id) for ref in out]}
 
 
-class ProcessWorkerHandle(WirePeer):
-    """One worker process: socket, reader thread, in-flight tasks, borrows."""
+class WorkerChannel(WirePeer):
+    """Protocol half of a worker handle: task dispatch + frame handling +
+    in-flight bookkeeping, independent of WHERE the worker process runs.
 
-    def __init__(self, engine: "ProcessNodeEngine"):
+    Subclasses provide the transport: ProcessWorkerHandle (local subprocess
+    over a socketpair) and remote_node.RemoteWorkerHandle (a worker hosted
+    by a node daemon on another machine, frames muxed over the node's TCP
+    connection)."""
+
+    def __init__(self, engine):
         super().__init__(engine.runtime)
         self.engine = engine
         self.rpc_pool = engine.rpc_pool
         self.actor_id: Optional[ActorID] = None
         self.expected_death = False
+        # Set by the memory monitor before an OOM kill: the in-flight tasks
+        # fail with OutOfMemoryError instead of a generic crash.
+        self.death_note: Optional[str] = None
         import time as _time
 
         self.last_pong = _time.monotonic()
         # task_id bytes -> (spec, grant)
         self.in_flight: dict[bytes, tuple[TaskSpec, dict]] = {}
+        # When the most recent task was dispatched here — the memory
+        # monitor's retriable-FIFO policy kills the NEWEST victim first
+        # (least progress lost).
+        self.last_dispatch = 0.0
+
+    # Transport hooks -------------------------------------------------------
+
+    def describe(self) -> str:
+        """Human-readable worker identity for error messages."""
+        return "worker"
+
+    def _ref_in_native(self, oid) -> bool:
+        """Whether THIS worker can read the arg zero-copy from the shm store
+        it is attached to (the head's for local workers, its node's for
+        remote ones)."""
+        return False
+
+    def kill_process(self) -> None:
+        raise NotImplementedError
+
+    def _post_disconnect(self) -> None:
+        """Transport-specific cleanup after in-flight failure handling."""
+
+    def _seal_native_return(self, spec: TaskSpec, body: dict) -> "TaskResult":
+        """Adopt an in_native return (bytes already sealed into a store)."""
+        raise NotImplementedError
+
+
+class ProcessWorkerHandle(WorkerChannel):
+    """One worker process: socket, reader thread, in-flight tasks, borrows."""
+
+    def __init__(self, engine: "ProcessNodeEngine"):
+        super().__init__(engine)
         parent_sock, child_sock = socket.socketpair()
         env = os.environ.copy()
         env["RAY_TPU_WORKER_FD"] = str(child_sock.fileno())
@@ -298,11 +353,9 @@ class ProcessWorkerHandle(WirePeer):
     # -- sending tasks -----------------------------------------------------
 
     def _wire_body(self, spec: TaskSpec, grant: dict) -> dict:
-        store = self.runtime.store
-
         def wrap(value):
             if isinstance(value, ObjectRef):
-                return wire.WireRef(value.id.binary(), store.is_native(value.id))
+                return wire.WireRef(value.id.binary(), self._ref_in_native(value.id))
             return value
 
         body = {
@@ -316,8 +369,19 @@ class ProcessWorkerHandle(WirePeer):
             "max_concurrency": spec.max_concurrency,
             "runtime_env": spec.runtime_env,
             "grant": dict(grant),
-            "args": tuple(wrap(a) for a in spec.args),
-            "kwargs": {k: wrap(v) for k, v in spec.kwargs.items()},
+            # args/kwargs are user data: nested as a separately-pickled blob
+            # so the frame envelope always decodes on the worker — a payload
+            # the worker can't deserialize (e.g. a function pickled by
+            # reference to a module only the driver can import) fails THIS
+            # task inside the worker's try/except instead of looking like
+            # protocol corruption and killing the process.
+            "payload": cloudpickle.dumps(
+                (
+                    tuple(wrap(a) for a in spec.args),
+                    {k: wrap(v) for k, v in spec.kwargs.items()},
+                ),
+                protocol=5,
+            ),
         }
         if spec.kind in (TaskKind.NORMAL, TaskKind.ACTOR_CREATION):
             body["func"] = cloudpickle.dumps(spec.func, protocol=5)
@@ -360,6 +424,9 @@ class ProcessWorkerHandle(WirePeer):
             return
         with self._lock:
             self.in_flight[spec.task_id.binary()] = (spec, grant)
+            import time as _time
+
+            self.last_dispatch = _time.monotonic()
         try:
             self.conn.send_bytes(payload)
         except Exception:
@@ -374,7 +441,7 @@ class ProcessWorkerHandle(WirePeer):
                     grant,
                     TaskResult(
                         exc=WorkerCrashedError(
-                            f"worker process (pid {self.proc.pid}) connection "
+                            f"{self.describe()} connection "
                             f"lost submitting {spec.name}"
                         )
                     ),
@@ -387,11 +454,18 @@ class ProcessWorkerHandle(WirePeer):
             try:
                 msg = self.conn.recv()
             except Exception:
+                traceback.print_exc()
+                msg = None
+            if msg is not None and msg[0] == "__decode_error__":
                 # Undecodable frame (e.g. an exception class whose unpickle
                 # raises). We can't know which task it belonged to, so the
                 # only hang-free option is to declare the worker dead: every
                 # in-flight task fails below and retries run on a fresh one.
-                traceback.print_exc()
+                print(
+                    f"worker {self.proc.pid}: undecodable frame, declaring "
+                    f"dead: {msg[1].get('error')}",
+                    file=sys.stderr,
+                )
                 msg = None
             if msg is None:
                 break
@@ -440,6 +514,23 @@ class ProcessWorkerHandle(WirePeer):
         elif kind == "ready":
             pass
 
+    @staticmethod
+    def _decode_exc(body: dict, spec: TaskSpec):
+        """Decode a pre-pickled worker exception; an exception class the
+        driver can't unpickle degrades to a RuntimeError for this task
+        instead of looking like wire corruption."""
+        raw = body.get("exc_pickled")
+        if raw is None:
+            return body.get("exc")
+        try:
+            return cloudpickle.loads(raw)
+        except Exception as exc:  # noqa: BLE001
+            return RuntimeError(
+                f"task {spec.name} failed with an exception the driver "
+                f"could not deserialize ({exc!r}); worker traceback:\n"
+                f"{body.get('tb', '')}"
+            )
+
     def _handle_done(self, body: dict) -> None:
         with self._lock:
             entry = self.in_flight.pop(body["task_id"], None)
@@ -450,25 +541,16 @@ class ProcessWorkerHandle(WirePeer):
             from ray_tpu.exceptions import TaskCancelledError
 
             result = TaskResult(
-                exc=body.get("exc") or TaskCancelledError(spec.task_id),
+                exc=self._decode_exc(body, spec) or TaskCancelledError(spec.task_id),
                 cancelled=True,
                 traceback_str=body.get("tb", ""),
             )
         elif not body["ok"]:
-            result = TaskResult(exc=body["exc"], traceback_str=body.get("tb", ""))
-        elif body.get("in_native"):
-            # Nested refs serialized into the shm bytes become borrows held
-            # by the sealed entry (same protocol as driver-side seal).
-            nested = [ObjectRef(ObjectID(raw)) for raw in body.get("nested", ())]
-            sealed = self.runtime.store.seal_native(
-                spec.return_ids[0], body["in_native"], nested_refs=nested or None
+            result = TaskResult(
+                exc=self._decode_exc(body, spec), traceback_str=body.get("tb", "")
             )
-            if sealed:
-                result = TaskResult(value=SEALED_EXTERNALLY)
-            else:  # shm raced an eviction; extremely unlikely — treat as lost
-                result = TaskResult(
-                    exc=WorkerCrashedError("shm-resident return value lost")
-                )
+        elif body.get("in_native"):
+            result = self._seal_native_return(spec, body)
         elif "value_pickled" in body:
             # Worker pre-serialized the single return: seal the bytes as-is.
             nested = [ObjectRef(ObjectID(raw)) for raw in body.get("nested", ())]
@@ -506,24 +588,48 @@ class ProcessWorkerHandle(WirePeer):
             if spec.kind in (TaskKind.ACTOR_CREATION, TaskKind.ACTOR_TASK):
                 exc: Exception = ActorDiedError(
                     spec.actor_id,
-                    self.death_reason_for(expected),
+                    self.death_note or self.death_reason_for(expected),
                 )
+            elif self.death_note:
+                from ray_tpu.exceptions import OutOfMemoryError
+
+                exc = OutOfMemoryError(self.death_note)
             else:
                 exc = WorkerCrashedError(
-                    f"worker process (pid {self.proc.pid}) died "
-                    f"while running {spec.name}"
+                    f"{self.describe()} died while running {spec.name}"
                 )
             self.runtime._on_task_done(
                 spec, self.engine.node, grant, TaskResult(exc=exc)
             )
         self._drop_all_borrows()
+        self._post_disconnect()
+
+    def death_reason_for(self, expected: bool) -> str:
+        return "actor killed" if expected else "actor process died"
+
+    def describe(self) -> str:
+        return f"worker process (pid {self.proc.pid})"
+
+    def _ref_in_native(self, oid) -> bool:
+        return self.runtime.store.is_native(oid)
+
+    def _seal_native_return(self, spec: TaskSpec, body: dict) -> TaskResult:
+        # Nested refs serialized into the shm bytes become borrows held
+        # by the sealed entry (same protocol as driver-side seal).
+        nested = [ObjectRef(ObjectID(raw)) for raw in body.get("nested", ())]
+        sealed = self.runtime.store.seal_native(
+            spec.return_ids[0], body["in_native"], nested_refs=nested or None
+        )
+        if sealed:
+            return TaskResult(value=SEALED_EXTERNALLY)
+        # shm raced an eviction; extremely unlikely — treat as lost
+        return TaskResult(exc=WorkerCrashedError("shm-resident return value lost"))
+
+    def _post_disconnect(self) -> None:
         try:
             self.proc.kill()
         except Exception:
             pass
-
-    def death_reason_for(self, expected: bool) -> str:
-        return "actor killed" if expected else "actor process died"
 
     def kill_process(self) -> None:
         self.expected_death = True

@@ -1,0 +1,402 @@
+"""Head-side half of the multi-machine cluster: remote worker nodes.
+
+A node daemon (node_daemon.py — the raylet analog, raylet/main.cc) connects
+to the head's TCP server with preamble role 'N' and registers its resources.
+The head builds a NodeHandle (this file) around the connection, a NodeState
+for the scheduler, and a RemoteNodeEngine implementing the same NodeEngine
+interface the in-process/process engines implement — so scheduling, actors,
+retries, lineage recovery and placement groups work on remote nodes with no
+changes above this layer.
+
+Frame protocol over the node connection (all cloudpickle frames, wire.py):
+  head -> daemon:
+    spawn_worker {wid}            create a pooled/dedicated worker process
+    tw {wid, p: frame_bytes}      deliver a pre-framed message to worker wid
+    kill_worker {wid}             kill a worker process
+    delete_objects {oids}         drop objects from the node's local store
+    rpc_reply {...}               reply to a daemon-level RPC
+    ping {id}
+  daemon -> head:
+    register_node {...}           first frame (handled by accept_node)
+    wf {wid, k, b}                frame from worker wid (decoded by daemon)
+    worker_exit {wid}             a worker process died
+    rpc {id, method, payload}     daemon-level RPC (locate_object)
+    pong {id}
+
+Object bytes never ride this connection: each node (and the head) runs an
+object server (object_plane.py); the owner's location table directs pulls
+(reference: ownership_based_object_directory.h + pull_manager.h).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import cloudpickle
+
+from ray_tpu._private import wire
+from ray_tpu._private.controller import NodeState
+from ray_tpu._private.engine import TaskResult
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID
+from ray_tpu._private.process_engine import (
+    ProcessActorExecutor,
+    ProcessWorkerHandle,
+    WorkerChannel,
+)
+from ray_tpu._private.task_spec import TaskSpec
+
+
+class _MuxConn:
+    """Connection shim for one remote worker: frames are wrapped with the
+    worker id and ride the node daemon's TCP connection."""
+
+    def __init__(self, node_handle: "NodeHandle", wid: int):
+        self._node = node_handle
+        self._wid = wid
+
+    def send(self, kind: str, body: dict) -> None:
+        self.send_bytes(cloudpickle.dumps((kind, body), protocol=5))
+
+    def send_bytes(self, payload: bytes) -> None:
+        self._node.conn.send("tw", {"wid": self._wid, "p": payload})
+
+    def close(self) -> None:
+        pass  # the node connection outlives individual workers
+
+
+class RemoteWorkerHandle(ProcessWorkerHandle):
+    """A worker process hosted by a node daemon on a (possibly) remote
+    machine. Shares the full task/frame protocol with ProcessWorkerHandle;
+    only the transport and the return-sealing policy differ: returns sealed
+    into the node's local store are recorded as LOCATIONS here, not bytes."""
+
+    def __init__(self, engine: "RemoteNodeEngine", wid: int):
+        WorkerChannel.__init__(self, engine)  # deliberately skip the
+        # subprocess-spawning ProcessWorkerHandle.__init__: no local process
+        self.wid = wid
+        self.conn = _MuxConn(engine.handle, wid)
+
+    def describe(self) -> str:
+        return f"remote worker {self.wid} on node {self.engine.node.node_id}"
+
+    def _ref_in_native(self, oid) -> bool:
+        # True iff the arg's bytes are in THIS worker's node-local store.
+        return (
+            self.runtime.store.location_of(oid) == self.engine.node.node_id
+        )
+
+    def _seal_native_return(self, spec: TaskSpec, body: dict) -> TaskResult:
+        from ray_tpu._private.engine import SEALED_EXTERNALLY
+        from ray_tpu._private.object_ref import ObjectRef
+
+        nested = [ObjectRef(ObjectID(raw)) for raw in body.get("nested", ())]
+        self.runtime.store.seal_remote(
+            spec.return_ids[0],
+            self.engine.node.node_id,
+            body["in_native"],
+            nested_refs=nested or None,
+        )
+        return TaskResult(value=SEALED_EXTERNALLY)
+
+    def _post_disconnect(self) -> None:
+        pass  # the daemon reaps the OS process
+
+    def kill_process(self) -> None:
+        self.expected_death = True
+        try:
+            self.engine.handle.conn.send("kill_worker", {"wid": self.wid})
+        except Exception:
+            pass
+
+
+class NodeHandle:
+    """Owns the TCP connection to one registered node daemon."""
+
+    def __init__(self, runtime, conn: wire.Connection, reg: dict):
+        self.runtime = runtime
+        self.conn = conn
+        self.reg = reg
+        self.node_id = NodeID.from_random()
+        self.hostname = reg.get("hostname", "?")
+        self.object_addr = tuple(reg["object_addr"]) if reg.get("object_addr") else None
+        self.alive = True
+        self._lock = threading.Lock()
+        self._workers: dict[int, RemoteWorkerHandle] = {}
+        self._wid_counter = 0
+        import time as _time
+
+        self.last_pong = _time.monotonic()
+        self.engine: Optional["RemoteNodeEngine"] = None
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f"node-{self.hostname}",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self._reader.start()
+
+    def next_wid(self) -> int:
+        with self._lock:
+            self._wid_counter += 1
+            return self._wid_counter
+
+    def register_worker(self, handle: RemoteWorkerHandle) -> None:
+        with self._lock:
+            self._workers[handle.wid] = handle
+
+    def forget_worker(self, wid: int) -> None:
+        with self._lock:
+            self._workers.pop(wid, None)
+
+    # -- reader -------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except Exception:
+                traceback.print_exc()
+                msg = None
+            if msg is None:
+                break
+            kind, body = msg
+            try:
+                self._handle_frame(kind, body)
+            except Exception:
+                traceback.print_exc()
+        self._on_disconnect()
+
+    def _handle_frame(self, kind: str, body: dict) -> None:
+        if kind == "wf":
+            with self._lock:
+                handle = self._workers.get(body["wid"])
+            if handle is None:
+                return
+            if body["k"] == "__decode_error__":
+                # The daemon couldn't unpickle this worker's frame (e.g. a
+                # return value referencing a module the node cannot import).
+                # Same hang-free policy as the local path: declare the
+                # worker dead so in-flight work fails fast and retries.
+                print(
+                    f"node {self.hostname}: undecodable frame from worker "
+                    f"{body['wid']}, declaring dead: {body['b'].get('error')}",
+                    file=sys.stderr,
+                )
+                try:
+                    self.conn.send("kill_worker", {"wid": body["wid"]})
+                except Exception:
+                    pass
+                with self._lock:
+                    self._workers.pop(body["wid"], None)
+                handle._on_disconnect()
+                return
+            handle._handle_frame(body["k"], body["b"])
+        elif kind == "worker_exit":
+            with self._lock:
+                handle = self._workers.pop(body["wid"], None)
+            if handle is not None:
+                handle._on_disconnect()
+        elif kind == "rpc":
+            self.engine.rpc_pool.submit(self._handle_node_rpc, body)
+        elif kind == "pong":
+            import time
+
+            self.last_pong = time.monotonic()
+
+    def _handle_node_rpc(self, body: dict) -> None:
+        msg_id = body["id"]
+        try:
+            result = self._dispatch_node_rpc(body["method"], body["payload"])
+            reply = {"id": msg_id, "ok": True, "result": result}
+        except BaseException as exc:  # noqa: BLE001
+            reply = {"id": msg_id, "ok": False, "exc": exc}
+        try:
+            self.conn.send("rpc_reply", reply)
+        except Exception:
+            # An unpicklable error reply must still unblock the daemon's
+            # waiter (it would otherwise stall its 300s deadline and fail
+            # every pull deduped onto it).
+            try:
+                self.conn.send(
+                    "rpc_reply",
+                    {
+                        "id": msg_id,
+                        "ok": False,
+                        "exc": RuntimeError("unserializable node RPC reply"),
+                    },
+                )
+            except Exception:
+                pass
+
+    def _dispatch_node_rpc(self, method: str, payload: dict):
+        runtime = self.runtime
+        if method == "locate_object":
+            # Owner-directed location lookup: wait for the seal, then point
+            # the daemon at whichever object server holds the bytes.
+            oid = ObjectID(payload["oid"])
+            timeout = payload.get("timeout")
+            ready, _ = runtime.store.wait([oid], 1, timeout)
+            if not ready:
+                return {"missing": True}
+            location = runtime.store.location_of(oid)
+            if location is not None and location != self.node_id:
+                peer = runtime._node_handles.get(location)
+                if peer is not None and peer.object_addr:
+                    return {"addr": list(peer.object_addr)}
+            if location is None and runtime._object_server is not None:
+                return {"addr": list(runtime._object_server.address)}
+            return {"missing": True}
+        raise ValueError(f"unknown node RPC {method!r}")
+
+    # -- death --------------------------------------------------------------
+
+    def _on_disconnect(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.runtime.on_node_disconnected(self.node_id)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.conn.send("shutdown", {})
+        except Exception:
+            pass
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+class RemoteNodeEngine:
+    """NodeEngine interface over a node daemon: pooled remote workers +
+    per-actor dedicated remote workers (mirrors ProcessNodeEngine)."""
+
+    def __init__(self, node: NodeState, runtime, handle: NodeHandle):
+        self.node = node
+        self.runtime = runtime
+        self.handle = handle
+        handle.engine = self
+        self.alive = True
+        self._lock = threading.Lock()
+        self._idle: list[RemoteWorkerHandle] = []
+        self._workers: set[RemoteWorkerHandle] = set()
+        self._actors: dict[ActorID, ProcessActorExecutor] = {}
+        self.rpc_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix=f"rnode-{handle.hostname[:8]}"
+        )
+
+    # -- pool ---------------------------------------------------------------
+
+    def _checkout(self) -> RemoteWorkerHandle:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        wid = self.handle.next_wid()
+        worker = RemoteWorkerHandle(self, wid)
+        self.handle.register_worker(worker)
+        with self._lock:
+            self._workers.add(worker)
+        self.handle.conn.send("spawn_worker", {"wid": wid})
+        return worker
+
+    def checkin(self, handle: RemoteWorkerHandle) -> None:
+        with self._lock:
+            if self.alive and handle in self._workers:
+                self._idle.append(handle)
+
+    def forget(self, handle: RemoteWorkerHandle) -> None:
+        with self._lock:
+            self._workers.discard(handle)
+            self._idle = [h for h in self._idle if h is not handle]
+        self.handle.forget_worker(handle.wid)
+
+    # -- NodeEngine interface ----------------------------------------------
+
+    def execute_task(self, spec: TaskSpec, grant: dict, resolve_args) -> None:
+        handle = self._checkout()
+        handle.send_task("run_task", spec, grant)
+
+    def create_actor(self, spec: TaskSpec, grant: dict, resolve_args):
+        wid = self.handle.next_wid()
+        worker = RemoteWorkerHandle(self, wid)
+        self.handle.register_worker(worker)
+        with self._lock:
+            self._workers.add(worker)
+        self.handle.conn.send("spawn_worker", {"wid": wid})
+        executor = ProcessActorExecutor(self, worker, spec, grant)
+        with self._lock:
+            self._actors[spec.actor_id] = executor
+        executor.start()
+        return executor
+
+    def get_actor(self, actor_id: ActorID):
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def remove_actor(self, actor_id: ActorID) -> None:
+        with self._lock:
+            self._actors.pop(actor_id, None)
+
+    def shutdown(self) -> None:
+        self.alive = False
+        with self._lock:
+            workers = list(self._workers)
+            self._workers.clear()
+            self._idle.clear()
+            actors = list(self._actors.values())
+            self._actors.clear()
+        for actor in actors:
+            actor.mark_dead("node removed")
+        # Fail every in-flight task on this node's workers (the daemon is
+        # gone or being told to go; nothing will come back).
+        for worker in workers:
+            worker.expected_death = True
+            worker._on_disconnect()
+        self.handle.close()
+        self.rpc_pool.shutdown(wait=False, cancel_futures=True)
+
+
+def accept_node(runtime, conn: wire.Connection) -> None:
+    """Server-side node registration: read register_node, wire up the engine,
+    reply node_welcome (the GcsNodeManager::HandleRegisterNode analog)."""
+    msg = conn.recv()
+    if msg is None or msg[0] != "register_node":
+        conn.close()
+        return
+    reg = msg[1]
+    handle = NodeHandle(runtime, conn, reg)
+    cfg = runtime.config
+    # Welcome FIRST, register second: the moment the node is schedulable a
+    # concurrent dispatch may send spawn_worker on this connection, and the
+    # daemon requires node_welcome to be the first frame it reads.
+    conn.send(
+        "node_welcome",
+        {
+            "node_id": handle.node_id,
+            "job_id": runtime.job_id.binary(),
+            "driver_task_id": runtime.driver_task_id.binary(),
+            "namespace": runtime.namespace,
+            "native_threshold": cfg.native_store_threshold,
+            "worker_jax_platform": cfg.worker_jax_platform,
+            "health_check_period_s": cfg.health_check_period_s,
+            "health_check_failure_threshold": cfg.health_check_failure_threshold,
+            # The driver's import roots: functions cloudpickled by REFERENCE
+            # (importable module on the driver) must resolve on remote
+            # workers too. Nonexistent paths on the node's machine are
+            # harmless — Python skips them (services.py propagates the
+            # driver environment to raylets the same way).
+            "sys_path": [p for p in sys.path if p],
+        },
+    )
+    runtime.register_remote_node(handle, reg)
+    handle.start()

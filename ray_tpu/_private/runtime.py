@@ -186,10 +186,24 @@ class Runtime:
             spill_storage=self._spill_storage,
             serialize=self.config.serialize_objects,
         )
+        # Deferred-deletion reaper (see _on_object_out_of_scope for why the
+        # callback itself must never touch the store).
+        self._reap_queue: deque = deque()
+        self._reap_event = threading.Event()
+        self._reaper_thread = threading.Thread(
+            target=self._reaper_loop, name="object-reaper", daemon=True
+        )
+        self._reaper_thread.start()
         self.refcount = ReferenceCounter(
-            on_object_out_of_scope=lambda oid: self.store.delete([oid]),
+            on_object_out_of_scope=self._on_object_out_of_scope,
             on_lineage_released=self._release_lineage,
         )
+        # Multi-machine plane: registered node daemons + the head's half of
+        # the object plane (created lazily when the first node joins).
+        self._node_handles: dict[NodeID, Any] = {}
+        self._object_server = None
+        self._object_fetcher = None
+        self.store.set_remote_fetch(self._fetch_remote_object)
         # Lineage table: producing spec kept while any output is referenced,
         # enabling re-execution of lost objects (reference: lineage pinning,
         # reference_count.h:75 + object_recovery_manager.h:42). The retained
@@ -228,6 +242,23 @@ class Runtime:
         # the cluster's, not any caller's) — also the restore target for
         # control-plane persistence.
         self._detached_creation_refs: list = []
+        # Host-memory monitor: only process-backed workers are killable.
+        self.memory_monitor = None
+        if (
+            self.config.memory_usage_threshold
+            and self.config.isolation == "process"
+        ):
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self,
+                threshold=self.config.memory_usage_threshold,
+                period_s=self.config.memory_monitor_refresh_s,
+                kill_cooldown_ticks=self.config.memory_monitor_kill_cooldown_ticks,
+            )
+            self.scheduler.dispatch_gate = (
+                lambda: not self.memory_monitor.under_pressure
+            )
         _RUNTIME = self
         if resources is not None:
             self.add_node(resources, is_head=True)
@@ -276,6 +307,150 @@ class Runtime:
         self.controller.retry_pending_placement_groups()
         return node.node_id
 
+    # --------------------------------------------------------- remote nodes
+
+    def register_remote_node(self, handle, reg: dict) -> NodeID:
+        """A node daemon registered over TCP: build its NodeState + engine
+        (GcsNodeManager::HandleRegisterNode; the daemon is the raylet)."""
+        from ray_tpu._private.remote_node import RemoteNodeEngine
+
+        self._ensure_object_plane()
+        resources = {
+            k: float(v) for k, v in (reg.get("resources") or {}).items() if v
+        }
+        node = NodeState(handle.node_id, resources, reg.get("labels"))
+        engine = RemoteNodeEngine(node, self, handle)
+        with self._lock:
+            self.engines[node.node_id] = engine
+            self._node_handles[node.node_id] = handle
+        self.controller.register_node(node)
+        self.controller.retry_pending_placement_groups()
+        self.scheduler.notify()
+        return handle.node_id
+
+    def on_node_disconnected(self, node_id: NodeID) -> None:
+        """Node daemon connection dropped: treat as node death — objects
+        whose only copy lived there become lost (lineage recovery), actors
+        restart elsewhere, dispatched tasks retry."""
+        self.remove_node(node_id)
+
+    def _ensure_object_plane(self) -> None:
+        from ray_tpu._private.object_plane import ObjectFetcher, ObjectServer
+
+        if self._object_fetcher is not None:
+            return
+        head = getattr(self, "_head_server", None)
+        token = head.token if head else ""
+        # Bind where the control plane binds: a loopback-only (or
+        # auth-disabled, trusted-local) head must not silently widen its
+        # exposure through the object plane.
+        host = head.host if head else "127.0.0.1"
+        self._object_fetcher = ObjectFetcher(token)
+        try:
+            self._object_server = ObjectServer(
+                self._object_bytes_provider, token, host=host
+            )
+        except OSError:
+            self._object_server = None
+
+    def _object_bytes_provider(self, oid_bytes: bytes):
+        """Serve this process's copy of an object to a pulling peer."""
+        from ray_tpu._private.object_plane import TAG_ENVELOPE, TAG_PICKLE
+
+        oid = ObjectID(oid_bytes)
+        ns = self._native_store
+        if ns is not None:
+            view = ns.get_raw(oid)
+            if view is not None:
+                try:
+                    data = bytes(view)
+                finally:
+                    del view
+                    ns.release(oid)
+                return (TAG_ENVELOPE, data)
+        data = self.store.get_serialized(oid)
+        if data is not None:
+            return (TAG_PICKLE, data)
+        try:
+            if self.store.contains(oid) and self.store.location_of(oid) is None:
+                value = self.store.get(oid, timeout=0)
+                return (TAG_PICKLE, cloudpickle.dumps(value, protocol=5))
+        except Exception:
+            return None
+        return None
+
+    def _fetch_remote_object(self, oid: ObjectID, node_id: NodeID):
+        """Pull a remotely-located object's bytes from the holding node's
+        object server and cache them locally (the head-side PullManager)."""
+        from ray_tpu._private import native_store as native_mod
+        from ray_tpu._private.object_plane import TAG_ENVELOPE
+
+        handle = self._node_handles.get(node_id)
+        if handle is None or not handle.alive or not handle.object_addr:
+            raise ObjectLostError(
+                oid, f"Object {oid} lived on node {node_id}, which is gone"
+            )
+        try:
+            fetched = self._object_fetcher.fetch(handle.object_addr, oid.binary())
+        except (ConnectionError, OSError) as exc:
+            raise ObjectLostError(
+                oid, f"Pull of {oid} from node {node_id} failed: {exc}"
+            ) from None
+        if fetched is None:
+            raise ObjectLostError(
+                oid, f"Object {oid} was evicted from node {node_id}"
+            )
+        tag, data = fetched
+        if tag == TAG_ENVELOPE:
+            ns = self._native_store
+            if ns is not None:
+                try:
+                    ns.put_raw(oid, data)
+                    self.store.adopt_fetched_native(oid)
+                except Exception:
+                    pass  # shm full: serve this read, stay remote-located
+            return native_mod.decode_envelope(data)
+        value = cloudpickle.loads(data)
+        self.store.adopt_fetched(oid, None, pickled=data)
+        return value
+
+    def _on_object_out_of_scope(self, oid: ObjectID) -> None:
+        """Out-of-scope callback fires from ObjectRef.__del__, which the
+        cyclic GC can run at ANY allocation — including on a thread that
+        already holds the store lock. Touching the store here would deadlock
+        (observed: GC inside _ensure_entry -> this callback -> store lock),
+        so the actual deletion is deferred to the reaper thread."""
+        self._reap_queue.append(oid)
+        self._reap_event.set()
+
+    def _reaper_loop(self) -> None:
+        """Processes deferred object deletions: notifies the holding node
+        daemon (if the bytes live remotely) and drops the local entry."""
+        while True:
+            self._reap_event.wait()
+            if self.shutting_down:
+                return
+            self._reap_event.clear()
+            while self._reap_queue:
+                try:
+                    oid = self._reap_queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    location = self.store.location_of(oid)
+                    if location is not None:
+                        handle = self._node_handles.get(location)
+                        if handle is not None and handle.alive:
+                            try:
+                                handle.conn.send(
+                                    "delete_objects", {"oids": [oid.binary()]}
+                                )
+                            except Exception:
+                                pass
+                    self.store.delete([oid])
+                except Exception:
+                    pass  # a single bad entry must not stop the reaper
+
     def remove_node(self, node_id: NodeID) -> None:
         """Simulate node failure: actors die (and maybe restart elsewhere);
         dispatched tasks are treated as system failures (retry or lost)."""
@@ -283,8 +458,15 @@ class Runtime:
         with self._lock:
             engine = self.engines.pop(node_id, None)
             companion = self._companions.pop(node_id, None)
+            node_handle = self._node_handles.pop(node_id, None)
         if companion is not None:
             companion.shutdown()
+        if node_handle is not None:
+            # Objects whose only bytes lived on that node are lost — but
+            # leave their entries sealed+located: the next read's fetch
+            # raises ObjectLostError (dead node), which is what triggers
+            # lineage recovery. Unsealing here would block readers forever.
+            node_handle.alive = False
         if engine is None:
             return
         # Collect this node's actors before shutdown kills them.
@@ -1165,13 +1347,24 @@ class Runtime:
             except Exception:
                 pass
         self.shutting_down = True
+        self._reap_event.set()  # release the reaper thread
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         self.scheduler.shutdown()
         with self._lock:
             engines = list(self.engines.values()) + list(self._companions.values())
             self.engines.clear()
             self._companions.clear()
+            self._node_handles.clear()
         for engine in engines:
             engine.shutdown()
+        if self._object_server is not None:
+            try:
+                self._object_server.stop()
+            except Exception:
+                pass
+        if self._object_fetcher is not None:
+            self._object_fetcher.close()
         self._background.shutdown(wait=False, cancel_futures=True)
         if self._native_store is not None:
             try:

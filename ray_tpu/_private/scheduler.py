@@ -94,6 +94,11 @@ def resolve_pg_request(
         pg_resource_name(res, pg.id, index if index >= 0 else None): amount
         for res, amount in request.items()
     }
+    # Bundle marker: pins the task to the bundle's node even when it
+    # requests zero resources (num_cpus=0 actors still belong to the PG).
+    rewritten[
+        pg_resource_name("bundle", pg.id, index if index >= 0 else None)
+    ] = 0.001
     return rewritten, record
 
 
@@ -113,6 +118,10 @@ class Scheduler:
         self._spread_cursor = 0
         self._running = True
         self.fail_on_infeasible = True
+        # Memory-pressure backpressure: while this returns False, no new
+        # leases are handed out (the reference raylet stops dispatch while
+        # its memory monitor reports pressure).
+        self.dispatch_gate: Callable[[], bool] = lambda: True
         self._demand_listeners: list = []  # autoscaler hook
         self._thread = threading.Thread(
             target=self._loop, name="ray_tpu-scheduler", daemon=True
@@ -185,6 +194,12 @@ class Scheduler:
                 # come back; unplaced ones are re-queued at the front. Keeps
                 # the loop O(queue) per pass instead of O(queue^2) (the
                 # 1M-queued-tasks envelope, BASELINE.md single-node table).
+                if not self.dispatch_gate():
+                    # Host memory pressure: hold the queue until the monitor
+                    # clears the gate (it notifies on transition) or a kill
+                    # frees memory; the timeout bounds a stuck gate.
+                    self._cond.wait(timeout=0.5)
+                    continue
                 batch = list(self._queue)
                 self._queue.clear()
                 self._in_pass = batch

@@ -47,7 +47,14 @@ class Connection:
             self._sock.sendall(frame)
 
     def recv(self) -> Optional[tuple[str, dict]]:
-        """Blocking read of one frame; None on clean EOF/reset."""
+        """Blocking read of one frame; None on clean EOF/reset.
+
+        A frame that reads fully but fails to unpickle comes back as a
+        ``("__decode_error__", {"error": ...})`` tuple: the stream framing is
+        intact (the bad payload was consumed), so the caller decides whether
+        to skip the frame or declare the peer dead — user data never rides
+        raw in frames (func/args/values are nested pre-pickled bytes), so a
+        decode error here means genuine protocol corruption."""
         header = self._recv_exact(_LEN.size)
         if header is None:
             return None
@@ -55,7 +62,10 @@ class Connection:
         payload = self._recv_exact(length)
         if payload is None:
             return None
-        return cloudpickle.loads(payload)
+        try:
+            return cloudpickle.loads(payload)
+        except Exception as exc:  # noqa: BLE001 — undecodable payload
+            return ("__decode_error__", {"error": repr(exc)})
 
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = self._recv_buf

@@ -406,6 +406,16 @@ class Worker:
             raise ConnectionError("driver connection lost")
         if slot["ok"]:
             return slot["result"]
+        raw = slot.get("exc_pickled")
+        if raw is not None:
+            try:
+                exc = cloudpickle.loads(raw)
+            except Exception as decode_exc:  # noqa: BLE001
+                exc = RuntimeError(
+                    f"RPC {method} failed with an exception this worker "
+                    f"could not deserialize ({decode_exc!r})"
+                )
+            raise exc
         raise slot["exc"]
 
     def _fail_all_rpcs(self) -> None:
@@ -430,6 +440,17 @@ class Worker:
             if msg is None:
                 break  # driver died: fate-share
             kind, body = msg
+            if kind == "__decode_error__":
+                # Driver->worker frames are envelope-safe (task payloads and
+                # rpc_reply values/exceptions ride as nested pre-pickled
+                # bytes), so an undecodable envelope is real corruption:
+                # fate-share so in-flight work fails fast and retries on a
+                # fresh worker instead of hanging an rpc waiter forever.
+                print(
+                    f"worker: undecodable frame, exiting: {body.get('error')}",
+                    file=sys.stderr,
+                )
+                break
             if kind == "rpc_reply":
                 with self._rpc_lock:
                     waiter = self._rpc_waiters.pop(body["id"], None)
@@ -498,8 +519,12 @@ class Worker:
                 return self.proxy._get_one(ObjectID(value.oid_bytes), timeout=None)
             return value
 
-        args = tuple(materialize(a) for a in body.get("args", ()))
-        kwargs = {k: materialize(v) for k, v in body.get("kwargs", {}).items()}
+        # User args ride as a nested pre-pickled blob (see _wire_body): an
+        # undeserializable payload raises HERE, inside the per-task
+        # try/except, and fails only this task.
+        raw_args, raw_kwargs = cloudpickle.loads(body["payload"])
+        args = tuple(materialize(a) for a in raw_args)
+        kwargs = {k: materialize(v) for k, v in raw_kwargs.items()}
         return args, kwargs
 
     def _send_done(self, spec: TaskSpec, result) -> None:
@@ -509,15 +534,19 @@ class Worker:
             "tb": result.traceback_str,
         }
         if result.exc is not None:
-            wire.send_with_fallback(
-                self.conn,
-                "done",
-                {**body, "ok": False, "exc": result.exc},
-                {
-                    **body,
-                    "ok": False,
-                    "exc": RuntimeError(f"unserializable exception: {result.exc!r}"),
-                },
+            # Exceptions are user data: ship pre-pickled so a class the
+            # driver can't unpickle degrades to a task error there instead
+            # of corrupting the frame envelope (driver kills the worker on
+            # envelope corruption).
+            try:
+                exc_bytes = cloudpickle.dumps(result.exc, protocol=5)
+            except Exception:
+                exc_bytes = cloudpickle.dumps(
+                    RuntimeError(f"unserializable exception: {result.exc!r}"),
+                    protocol=5,
+                )
+            self.proxy._send_quiet(
+                "done", {**body, "ok": False, "exc_pickled": exc_bytes}
             )
             return
         value = result.value

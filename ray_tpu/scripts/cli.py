@@ -107,6 +107,29 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_start(args) -> int:
+    """Join an existing head as a worker node (`ray start --address=...`,
+    reference: services.py:1353 start_raylet). Blocks until the head goes
+    away; the daemon fate-shares with its connection."""
+    from ray_tpu._private import node_daemon
+
+    daemon_args = ["--address", args.address]
+    if args.num_cpus is not None:
+        daemon_args += ["--num-cpus", str(args.num_cpus)]
+    if args.num_gpus is not None:
+        daemon_args += ["--num-gpus", str(args.num_gpus)]
+    if args.num_tpus is not None:
+        daemon_args += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        daemon_args += ["--resources", args.resources]
+    if args.labels:
+        daemon_args += ["--labels", args.labels]
+    if args.object_store_memory:
+        daemon_args += ["--object-store-memory", str(args.object_store_memory)]
+    node_daemon.main(daemon_args)
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="TPU-native distributed ML framework CLI"
@@ -136,6 +159,19 @@ def main(argv: Optional[list] = None) -> int:
 
     sub.add_parser("metrics", help="prometheus exposition dump")
 
+    p_start = sub.add_parser(
+        "start", help="join a head as a worker node (node daemon)"
+    )
+    p_start.add_argument(
+        "--address", required=True, help="head connect string host:port?token=..."
+    )
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--num-gpus", type=float, default=None)
+    p_start.add_argument("--num-tpus", type=float, default=None)
+    p_start.add_argument("--resources", default=None, help="extra resources JSON")
+    p_start.add_argument("--labels", default=None, help="node labels JSON")
+    p_start.add_argument("--object-store-memory", type=int, default=None)
+
     args = parser.parse_args(argv)
     handler = {
         "status": cmd_status,
@@ -144,6 +180,7 @@ def main(argv: Optional[list] = None) -> int:
         "timeline": cmd_timeline,
         "job": cmd_job,
         "metrics": cmd_metrics,
+        "start": cmd_start,
     }[args.cmd]
     return handler(args)
 

@@ -15,10 +15,13 @@
 //
 // C ABI at the bottom is consumed by ctypes (ray_tpu/_private/native_store.py).
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -488,6 +491,62 @@ int tps_put(Store* s, const uint8_t* id, const void* data, uint64_t size) {
   int rc = tps_create(s, id, size, &dst);
   if (rc != 0) return rc;
   memcpy(dst, data, size);
+  return tps_seal(s, id);
+}
+
+// Gather-put: create one allocation of `total` bytes, copy n buffers to
+// their offsets within it (caller computes the envelope layout), seal.
+// The copies run OUTSIDE the store mutex (the slot is kCreated, invisible
+// to readers) and, for large payloads, striped across `nthreads` threads —
+// a single memcpy stream does not saturate server memory bandwidth, which
+// is what separates plasma's 19 GB/s from a naive copy loop.
+int tps_put_gather(Store* s, const uint8_t* id, const void** bufs,
+                   const uint64_t* lens, const uint64_t* offs, int32_t n,
+                   uint64_t total, int32_t nthreads) {
+  void* dst = nullptr;
+  int rc = tps_create(s, id, total, &dst);
+  if (rc != 0) return rc;
+  uint8_t* base = reinterpret_cast<uint8_t*>(dst);
+  constexpr uint64_t kStripe = 4ull << 20;  // 4 MB copy tasks
+  if (nthreads <= 1 || total < 2 * kStripe) {
+    for (int32_t i = 0; i < n; i++) memcpy(base + offs[i], bufs[i], lens[i]);
+    return tps_seal(s, id);
+  }
+  // Flatten buffers into ~4MB tasks, then run them on nthreads workers.
+  struct Task {
+    const uint8_t* src;
+    uint8_t* dst;
+    uint64_t len;
+  };
+  std::vector<Task> tasks;
+  for (int32_t i = 0; i < n; i++) {
+    const uint8_t* src = reinterpret_cast<const uint8_t*>(bufs[i]);
+    uint8_t* d = base + offs[i];
+    uint64_t left = lens[i];
+    while (left > 0) {
+      uint64_t step = left < kStripe ? left : kStripe;
+      tasks.push_back({src, d, step});
+      src += step;
+      d += step;
+      left -= step;
+    }
+  }
+  std::atomic<size_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= tasks.size()) return;
+      memcpy(tasks[i].dst, tasks[i].src, tasks[i].len);
+    }
+  };
+  int32_t spawn = nthreads - 1;
+  if (spawn > static_cast<int32_t>(tasks.size()) - 1)
+    spawn = static_cast<int32_t>(tasks.size()) - 1;
+  std::vector<std::thread> threads;
+  threads.reserve(spawn);
+  for (int32_t t = 0; t < spawn; t++) threads.emplace_back(worker);
+  worker();
+  for (auto& t : threads) t.join();
   return tps_seal(s, id);
 }
 

@@ -1,0 +1,289 @@
+"""Multi-machine cluster: head + node daemons as separate OS processes.
+
+The keystone multi-node test the reference runs via cluster_utils.Cluster
+(python/ray/cluster_utils.py:99) — but here each "node" is a REAL node
+daemon process (ray_tpu._private.node_daemon, the raylet analog) joining
+the head over TCP, with its own local shm store, worker processes, and
+object server. Localhost stands in for the network; the code path is the
+one a second machine takes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _wait_for(predicate, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cluster():
+    """Head (2 CPUs) + two node daemons (4 CPUs each, tagged nodeA/nodeB)."""
+    runtime = ray_tpu.init(
+        num_cpus=2, _system_config={"isolation": "process"}
+    )
+    address = runtime.serve_clients(port=0)
+    daemons = []
+    for tag in ("nodeA", "nodeB"):
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.node_daemon",
+                "--address",
+                address,
+                "--num-cpus",
+                "4",
+                "--resources",
+                '{"%s": 1}' % tag,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        daemons.append(proc)
+    try:
+        _wait_for(
+            lambda: len(runtime.controller.alive_nodes()) == 3,
+            msg="2 daemons to register",
+        )
+        yield runtime, daemons
+    finally:
+        for proc in daemons:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+def test_tasks_run_on_remote_nodes(cluster):
+    runtime, daemons = cluster
+
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid(), os.getppid()
+
+    a = ray_tpu.get(whoami.options(resources={"nodeA": 0.1}).remote())
+    b = ray_tpu.get(whoami.options(resources={"nodeB": 0.1}).remote())
+    daemon_pids = {p.pid for p in daemons}
+    # Each task ran in a worker forked by the matching daemon, not the head.
+    assert a[1] in daemon_pids and b[1] in daemon_pids
+    assert a[1] != b[1]
+    assert a[0] != os.getpid() and b[0] != os.getpid()
+
+
+def test_cross_node_object_transfer(cluster):
+    runtime, daemons = cluster
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def produce():
+        # Large enough to land in nodeA's local shm store (not the socket).
+        return np.arange(1_000_000, dtype=np.float32)
+
+    @ray_tpu.remote(resources={"nodeB": 0.1})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    # (a) another node pulls the bytes through the object plane
+    assert ray_tpu.get(consume.remote(ref)) == float(
+        np.arange(1_000_000, dtype=np.float32).sum()
+    )
+    # The object's bytes were produced on nodeA (location recorded, not
+    # copied to the head until read).
+    # (b) the driver pulls them too
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (1_000_000,) and arr[-1] == 999_999.0
+
+
+def test_small_values_roundtrip(cluster):
+    runtime, daemons = cluster
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def small():
+        return {"answer": 42}
+
+    assert ray_tpu.get(small.remote()) == {"answer": 42}
+
+
+def test_remote_actor(cluster):
+    runtime, daemons = cluster
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+            self.pid = os.getpid()
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+        def where(self):
+            return self.pid, os.getppid()
+
+    c = Counter.options(resources={"nodeB": 0.1}).remote()
+    assert ray_tpu.get([c.add.remote(1), c.add.remote(2), c.add.remote(3)]) == [
+        1,
+        3,
+        6,
+    ]
+    pid, ppid = ray_tpu.get(c.where.remote())
+    assert ppid in {p.pid for p in daemons}
+
+
+def test_object_passed_from_head_to_remote_worker(cluster):
+    runtime, daemons = cluster
+    big = ray_tpu.put(np.ones(500_000, dtype=np.float64))
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def total(arr):
+        return float(arr.sum())
+
+    assert ray_tpu.get(total.remote(big)) == 500_000.0
+
+
+def _node_id_with_resource(runtime, name: str):
+    for node in runtime.controller.alive_nodes():
+        if name in node.total:
+            return node.node_id
+    raise AssertionError(f"no node with resource {name}")
+
+
+def test_node_death_object_recovery(cluster):
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    runtime, daemons = cluster
+    node_a = _node_id_with_resource(runtime, "nodeA")
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        return np.full(300_000, 7.0)
+
+    # Soft affinity: first attempt lands on nodeA; the recovery re-execution
+    # falls back to any surviving node once nodeA is gone.
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_a.hex(), soft=True
+        )
+    ).remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready  # sealed on nodeA; bytes NOT pulled to the head yet
+    daemons[0].kill()
+    _wait_for(
+        lambda: len(runtime.controller.alive_nodes()) == 2,
+        msg="node death detected",
+    )
+    # The only copy died with the node: this get must re-execute the
+    # producer from lineage on a surviving node.
+    arr = ray_tpu.get(ref)
+    assert float(arr[0]) == 7.0 and arr.shape == (300_000,)
+
+
+def _dp_train_step(mesh):
+    """One dp-sharded SGD step over the cross-daemon mesh (gradients ride
+    cross-process collectives, the path ICI/DCN takes on a real slice)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w = jax.device_put(jnp.zeros((16,)), NamedSharding(mesh, P()))
+    xs = jax.make_array_from_callback(
+        (8, 16),
+        NamedSharding(mesh, P(("dp", "tp"), None)),
+        lambda idx: np.ones((8, 16), np.float32)[idx],
+    )
+    ys = jax.make_array_from_callback(
+        (8,),
+        NamedSharding(mesh, P(("dp", "tp"))),
+        lambda idx: np.full((8,), 3.0, np.float32)[idx],
+    )
+
+    @jax.jit
+    def step(w, xs, ys):
+        loss, grad = jax.value_and_grad(
+            lambda w: jnp.mean((xs @ w - ys) ** 2)
+        )(w)
+        return w - 0.01 * grad, loss
+
+    losses = []
+    for _ in range(3):
+        w, loss = step(w, xs, ys)
+        losses.append(float(loss))
+    return losses
+
+
+def test_mesh_across_daemons(cluster):
+    """The VERDICT's done-criterion (c): an 8-device jax.distributed mesh
+    formed ACROSS node daemons runs a distributed train step."""
+    from ray_tpu.parallel import MeshWorkerGroup
+    from ray_tpu.util.placement_group import placement_group
+
+    runtime, daemons = cluster
+    pg = placement_group(
+        [{"CPU": 1, "nodeA": 0.1}, {"CPU": 1, "nodeB": 0.1}],
+        strategy="STRICT_SPREAD",
+    )
+    assert pg.ready(timeout=30)
+    group = MeshWorkerGroup(
+        num_hosts=2, local_device_count=4, placement_group=pg
+    ).start(timeout=180)
+    try:
+        assert group.global_device_count == 8
+
+        def ppid_fn():
+            import os
+
+            return os.getppid()
+
+        # One mesh host per DAEMON: the worker processes are children of the
+        # two node daemons, not of the head.
+        assert set(group.run(ppid_fn)) == {p.pid for p in daemons}
+        results = group.run_with_mesh((2, 4), ("dp", "tp"), _dp_train_step)
+        assert results[0] == results[1]  # SPMD: identical on both hosts
+        assert results[0][0] > results[0][1] > results[0][2]  # learning
+    finally:
+        group.shutdown()
+
+
+def test_actor_restart_after_node_death(cluster):
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    runtime, daemons = cluster
+    node_a = _node_id_with_resource(runtime, "nodeA")
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Svc:
+        def where(self):
+            return os.getppid()
+
+    s = Svc.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_a.hex(), soft=True
+        )
+    ).remote()
+    first = ray_tpu.get(s.where.remote())
+    assert first == daemons[0].pid
+    daemons[0].kill()
+    _wait_for(
+        lambda: len(runtime.controller.alive_nodes()) == 2,
+        msg="node death detected",
+    )
+    # max_restarts=1: the actor comes back on a surviving node.
+    second = ray_tpu.get(s.where.remote())
+    assert second != first
