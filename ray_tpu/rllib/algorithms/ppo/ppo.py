@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.core.learner import Learner
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.policy.sample_batch import SampleBatch, concat_samples
 
 
 class PPOConfig(AlgorithmConfig):
@@ -115,4 +115,70 @@ class PPOLearner(Learner):
 
 
 class PPO(Algorithm):
+    """PPO with sampling/learning overlap: remote runners keep producing
+    the NEXT iteration's fragments while the learner runs SGD on the
+    current batch (reference: ppo.py training_step's
+    `AsyncRequestsManager`-era overlap + the IMPALA feed pattern). Actor
+    call ordering makes the staleness exactly one iteration — a re-armed
+    sample() is queued ahead of the post-update set_weights(), so its
+    fragments carry the previous weights' ACTION_LOGP, which is what the
+    clipped importance ratio is for."""
+
     config_class = PPOConfig
+
+    def training_step(self) -> dict:
+        import ray_tpu
+
+        cfg = self.algo_config
+        group = self.env_runner_group
+        runners = group.remote_runners()
+        if not runners:
+            return super().training_step()  # local-only: nothing to overlap
+        frag = cfg.get_rollout_fragment_length()
+        inflight: dict = getattr(self, "_inflight_samples", {})
+        # Arm every runner without a pending request (first iteration and
+        # replacements after failures).
+        for idx, runner in runners.items():
+            if idx not in inflight:
+                inflight[idx] = runner.sample.remote(frag)
+        batches: list = []
+        count = 0
+        while count < cfg.train_batch_size and inflight:
+            by_ref = {ref: idx for idx, ref in inflight.items()}
+            ready, _ = ray_tpu.wait(
+                list(inflight.values()), num_returns=1, timeout=300.0
+            )
+            if not ready:
+                raise RuntimeError("env runners produced no fragments in 300s")
+            for ref in ready:
+                idx = by_ref[ref]
+                del inflight[idx]
+                try:
+                    batch = ray_tpu.get(ref, timeout=60.0)
+                except Exception:
+                    group.handle_failures([idx])
+                    continue
+                batches.append(batch)
+                count += batch.count
+                # Re-arm immediately: this fragment (for the NEXT iteration)
+                # samples while the learner below runs SGD on this one.
+                runner = group.remote_runners().get(idx)
+                if runner is not None:
+                    inflight[idx] = runner.sample.remote(frag)
+            # Replacements for failed runners get armed next loop pass.
+            for idx, runner in group.remote_runners().items():
+                if idx not in inflight:
+                    inflight[idx] = runner.sample.remote(frag)
+        self._inflight_samples = inflight
+        if not batches:
+            raise RuntimeError("All env runners failed to sample")
+        train_batch = concat_samples(batches)
+        if self._output_writer is not None:
+            self._output_writer.write(train_batch)
+        self._env_steps_total += train_batch.count
+        learner_results = self.learner_group.update(train_batch)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(),
+            global_vars={"timestep": self._env_steps_total},
+        )
+        return dict(learner_results)

@@ -23,6 +23,11 @@ from ray_tpu.rllib.env.spaces import Space, flat_dim
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
 
 
+def _np_logsumexp(x: np.ndarray) -> np.ndarray:
+    m = np.max(x, axis=-1, keepdims=True)
+    return m + np.log(np.sum(np.exp(x - m), axis=-1, keepdims=True))
+
+
 class PiVfNet(nn.Module):
     """Default model: shared or separate MLP encoders + pi / vf heads
     (reference: core/models/catalog.py:28 default MLP encoder + heads)."""
@@ -128,6 +133,130 @@ class RLModule:
         pi_out, _ = self.apply(params, batch[SampleBatch.OBS])
         return {SampleBatch.ACTIONS: self.dist_cls(pi_out).deterministic_sample()}
 
+    # -- numpy rollout fast path ------------------------------------------
+
+    def np_exploration_fn(self) -> Optional[Callable]:
+        """A pure-numpy forward_exploration for CPU rollout hosts, or None.
+
+        A jitted call costs ~350us of dispatch per env step on CPU — 10x
+        the actual math for the default MLP — and dominated sampling
+        throughput (the reference's analog is running the torch policy
+        on the rollout worker's CPU). Only the stock PiVfNet +
+        Categorical/DiagGaussian combination qualifies; custom nets and
+        overridden forward_exploration keep the jitted path. Weights are
+        re-extracted to numpy lazily after each set_state.
+
+        Returns fn(obs, np_rng) -> fwd dict (same keys/semantics as
+        forward_exploration)."""
+        from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian
+
+        if type(self).forward_exploration is not RLModule.forward_exploration:
+            return None
+        if not isinstance(self.net, PiVfNet):
+            return None
+        if self.dist_cls not in (Categorical, DiagGaussian):
+            return None
+        return self._np_explore
+
+    def _np_weights(self):
+        cached = getattr(self, "_np_weight_cache", None)
+        if cached is not None and cached[0] is self.params:
+            return cached[1]
+        p = jax.device_get(self.params)["params"]
+        net: PiVfNet = self.net
+
+        def chain(prefix):
+            out = []
+            for i in range(len(net.hiddens)):
+                layer = p[f"{prefix}_{i}"]
+                out.append(
+                    (np.asarray(layer["kernel"]), np.asarray(layer["bias"]))
+                )
+            return out
+
+        weights = {
+            "pi": chain("pi"),
+            "vf": None if net.vf_share_layers else chain("vf"),
+            "pi_head": (
+                np.asarray(p["pi_head"]["kernel"]),
+                np.asarray(p["pi_head"]["bias"]),
+            ),
+            "vf_head": (
+                np.asarray(p["vf_head"]["kernel"]),
+                np.asarray(p["vf_head"]["bias"]),
+            ),
+            "act": {
+                "tanh": np.tanh,
+                "relu": lambda x: np.maximum(x, 0.0),
+                "swish": lambda x: x / (1.0 + np.exp(-x)),
+            }[net.activation],
+        }
+        self._np_weight_cache = (self.params, weights)
+        return weights
+
+    def _np_explore(self, obs: "np.ndarray", rng: "np.random.Generator") -> dict:
+        from ray_tpu.rllib.core.distributions import Categorical
+
+        w = self._np_weights()
+        act = w["act"]
+        x = obs.reshape(obs.shape[0], -1)
+        z = x
+        for kernel, bias in w["pi"]:
+            z = act(z @ kernel + bias)
+        pi_out = z @ w["pi_head"][0] + w["pi_head"][1]
+        if w["vf"] is None:
+            zv = z
+        else:
+            zv = x
+            for kernel, bias in w["vf"]:
+                zv = act(zv @ kernel + bias)
+        vf = (zv @ w["vf_head"][0] + w["vf_head"][1])[:, 0]
+        if self.dist_cls is Categorical:
+            # Same normalization as distributions.Categorical so ACTION_LOGP
+            # matches what the learner recomputes from ACTION_DIST_INPUTS.
+            logits = pi_out - _np_logsumexp(pi_out)
+            gumbel = -np.log(
+                -np.log(rng.random(pi_out.shape, dtype=np.float64) + 1e-20)
+            )
+            actions = np.argmax(logits + gumbel, axis=-1)
+            logp = np.take_along_axis(logits, actions[:, None], axis=-1)[:, 0]
+        else:
+            mean, log_std = np.split(pi_out, 2, axis=-1)
+            std = np.exp(np.clip(log_std, -20.0, 2.0))
+            actions = mean + std * rng.standard_normal(mean.shape).astype(
+                mean.dtype
+            )
+            z_ = (actions - mean) / std
+            logp = np.sum(
+                -0.5 * z_**2 - np.log(std) - 0.5 * np.log(2.0 * np.pi), axis=-1
+            )
+        return {
+            SampleBatch.ACTIONS: actions,
+            SampleBatch.ACTION_LOGP: logp.astype(np.float32),
+            SampleBatch.ACTION_DIST_INPUTS: pi_out.astype(np.float32),
+            SampleBatch.VF_PREDS: vf.astype(np.float32),
+        }
+
+    def np_value_fn(self) -> Optional[Callable]:
+        """Pure-numpy V(s) companion to np_exploration_fn (bootstrap
+        values at truncations/fragment cuts)."""
+        if self.np_exploration_fn() is None:
+            return None
+
+        def value(obs: "np.ndarray") -> "np.ndarray":
+            w = self._np_weights()
+            act = w["act"]
+            x = obs.reshape(obs.shape[0], -1)
+            z = x
+            chain = w["pi"] if w["vf"] is None else w["vf"]
+            for kernel, bias in chain:
+                z = act(z @ kernel + bias)
+            return (z @ w["vf_head"][0] + w["vf_head"][1])[:, 0].astype(
+                np.float32
+            )
+
+        return value
+
     # -- state ------------------------------------------------------------
 
     def get_state(self) -> Any:
@@ -135,6 +264,7 @@ class RLModule:
 
     def set_state(self, params: Any) -> None:
         self.params = params
+        self._np_weight_cache = None
 
 
 @dataclasses.dataclass

@@ -1,10 +1,14 @@
 from ray_tpu.rllib.env.env import (
     Env,
     EnvContext,
+    GymnasiumEnv,
     MultiAgentEnv,
     SyncVectorEnv,
+    VectorEnv,
     make_env,
+    make_vector_env,
     register_env,
+    register_vector_env,
 )
 from ray_tpu.rllib.env.spaces import Box, Discrete, Space, flat_dim
 
@@ -13,10 +17,14 @@ __all__ = [
     "Discrete",
     "Env",
     "EnvContext",
+    "GymnasiumEnv",
     "MultiAgentEnv",
     "Space",
     "SyncVectorEnv",
+    "VectorEnv",
     "flat_dim",
     "make_env",
+    "make_vector_env",
     "register_env",
+    "register_vector_env",
 ]

@@ -71,3 +71,14 @@ def flat_dim(space: Space) -> int:
     if isinstance(space, Discrete):
         return space.n
     return int(np.prod(space.shape)) if space.shape else 1
+
+
+def from_gymnasium(space) -> Space:
+    """Translate a gymnasium space into the in-tree algebra (the adapter
+    half of env.GymnasiumEnv)."""
+    name = type(space).__name__
+    if name == "Discrete":
+        return Discrete(int(space.n))
+    if name == "Box":
+        return Box(space.low, space.high, shape=space.shape, dtype=space.dtype)
+    raise TypeError(f"Unsupported gymnasium space: {space!r}")

@@ -24,7 +24,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
-from ray_tpu.rllib.env.env import SyncVectorEnv, make_env
+from ray_tpu.rllib.env.env import make_vector_env
 from ray_tpu.rllib.env.spaces import Box
 from ray_tpu.rllib.evaluation.postprocessing import compute_gae_for_sample_batch
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
@@ -39,11 +39,10 @@ class EnvRunner:
         self.worker_index = worker_index
         num_envs = max(1, int(getattr(config, "num_envs_per_env_runner", 1)))
         env_cfg = getattr(config, "env_config", None) or {}
-        self.vector_env = SyncVectorEnv(
-            [
-                (lambda i=i: make_env(config.env, env_cfg, worker_index=worker_index))
-                for i in range(num_envs)
-            ]
+        # Natively-vectorized env when registered (one fused numpy step for
+        # all sub-envs), SyncVectorEnv wrapping otherwise.
+        self.vector_env = make_vector_env(
+            config.env, num_envs, env_cfg, worker_index=worker_index
         )
         self.num_envs = num_envs
         spec = RLModuleSpec(
@@ -92,7 +91,17 @@ class EnvRunner:
         seed = (getattr(config, "seed", 0) or 0) * 10007 + worker_index
         with jax.default_device(self._device):
             self._rng = jax.random.PRNGKey(seed)
-        self._split_fn = jax.jit(jax.random.split, device=self._device)
+        self._split_fn = jax.jit(
+            jax.random.split, static_argnums=(1,), device=self._device
+        )
+        # Pure-numpy rollout fast path (stock module on a CPU sampling
+        # host): skips ~350us of jit dispatch per env step.
+        self._np_explore = None
+        self._np_value = None
+        if device_kind == "cpu":
+            self._np_explore = self.module.np_exploration_fn()
+            self._np_value = self.module.np_value_fn()
+        self._np_rng = np.random.default_rng(seed ^ 0x5EED)
         self._obs, _ = self.vector_env.reset(seed=seed)
         self._eps_id = np.arange(num_envs, dtype=np.int64) + num_envs * worker_index * 1_000_000
         self._next_eps = self._eps_id.max() + 1
@@ -121,22 +130,32 @@ class EnvRunner:
         )
         B = self.num_envs
         cols: dict[str, list] = defaultdict(list)
-        for _ in range(T):
-            self._rng, key = self._split_fn(self._rng)
+        use_np = self._np_explore is not None
+        if not use_np:
+            # One split for the whole fragment instead of one jitted split
+            # per env step (dispatch overhead dominates sampling on CPU).
+            keys = self._split_fn(self._rng, T + 1)
+            self._rng = keys[0]
+        for t_step in range(T):
             obs = self._obs.astype(np.float32)
             if self.obs_filter is not None:
                 # Rows store FILTERED observations: the learner must see the
                 # same inputs the policy acted on.
                 obs = self.obs_filter(obs, update=True)
-            fwd_in = {SampleBatch.OBS: obs}
-            # Module-specific exploration knobs (epsilon etc.) enter the
-            # jitted forward as traced inputs, so schedules never retrace.
-            # Schedules tick on the cluster-wide step count (broadcast with
-            # weight syncs, like the reference's global_vars), falling back
-            # to local steps before the first sync.
-            timestep = max(self._global_timestep, self._steps_sampled)
-            fwd_in.update(self.module.exploration_inputs(timestep))
-            fwd = self._explore_fn(self.module.params, fwd_in, key)
+            if use_np:
+                fwd = self._np_explore(obs, self._np_rng)
+            else:
+                fwd_in = {SampleBatch.OBS: obs}
+                # Module-specific exploration knobs (epsilon etc.) enter the
+                # jitted forward as traced inputs, so schedules never
+                # retrace. Schedules tick on the cluster-wide step count
+                # (broadcast with weight syncs, like the reference's
+                # global_vars), falling back to local steps pre-first-sync.
+                timestep = max(self._global_timestep, self._steps_sampled)
+                fwd_in.update(self.module.exploration_inputs(timestep))
+                fwd = self._explore_fn(
+                    self.module.params, fwd_in, keys[t_step + 1]
+                )
             actions = np.asarray(fwd[SampleBatch.ACTIONS])
             env_actions = actions
             if self._is_continuous:
@@ -187,8 +206,9 @@ class EnvRunner:
                     )
                     if self.obs_filter is not None:
                         finals = self.obs_filter(finals, update=False)
-                    vals = np.asarray(self._vf_fn(self.module.params, finals))
-                    boot = np.where(truncs, vals, 0.0).astype(np.float32)
+                    boot = np.where(truncs, self._values(finals), 0.0).astype(
+                        np.float32
+                    )
                 cols[SampleBatch.VALUES_BOOTSTRAPPED].append(boot)
 
             self._ep_return += rewards
@@ -207,11 +227,15 @@ class EnvRunner:
             cut_obs = self._obs.astype(np.float32)
             if self.obs_filter is not None:
                 cut_obs = self.obs_filter(cut_obs, update=False)
-            vals = np.asarray(self._vf_fn(self.module.params, cut_obs))
+            vals = self._values(cut_obs)
             last = cols[SampleBatch.VALUES_BOOTSTRAPPED][-1]
             cols[SampleBatch.VALUES_BOOTSTRAPPED][-1] = np.where(
                 running, vals, last
             ).astype(np.float32)
+
+        compute_gae = getattr(self.config, "_compute_gae_on_runner", True)
+        if compute_gae and self._vf_fn is not None:
+            self._add_gae_columns(cols, B, T)
 
         # [T, B, ...] -> per-env contiguous [B*T, ...] so eps_id is contiguous.
         batch = SampleBatch(
@@ -220,15 +244,68 @@ class EnvRunner:
                 for k, v in cols.items()
             }
         )
-        self._steps_sampled += batch.count
-        if getattr(self.config, "_compute_gae_on_runner", True):
+        if compute_gae and self._vf_fn is None:
+            # Critic-less modules: the per-episode path (pure discounted
+            # returns, use_critic=False) still applies.
             batch = compute_gae_for_sample_batch(
                 batch,
                 gamma=getattr(self.config, "gamma", 0.99),
                 lambda_=getattr(self.config, "lambda_", 0.95),
                 use_gae=getattr(self.config, "use_gae", True),
+                use_critic=False,
             )
+        self._steps_sampled += batch.count
         return batch
+
+    def _add_gae_columns(self, cols: dict, B: int, T: int) -> None:
+        """Vectorized GAE over the whole [T, B] fragment in a handful of
+        numpy passes (identical math to postprocessing.compute_advantages
+        applied per episode, which costs ~1000 python-level episode slices
+        per fragment and dominated sampling time).
+
+        next-state values: vpred[t+1] inside an episode; at done steps the
+        VALUES_BOOTSTRAPPED column (V(final_obs) for truncations, 0 for
+        terminations); at the fragment cut the V(cut obs) the rollout loop
+        wrote there."""
+        gamma = float(getattr(self.config, "gamma", 0.99))
+        lambda_ = float(getattr(self.config, "lambda_", 0.95))
+        use_gae = bool(getattr(self.config, "use_gae", True))
+        rew = np.stack(cols[SampleBatch.REWARDS]).astype(np.float32)  # [T,B]
+        term = np.stack(cols[SampleBatch.TERMINATEDS])
+        trunc = np.stack(cols[SampleBatch.TRUNCATEDS])
+        done = term | trunc
+        vpred = np.stack(cols[SampleBatch.VF_PREDS]).astype(np.float32)
+        boot = np.stack(cols[SampleBatch.VALUES_BOOTSTRAPPED]).astype(np.float32)
+        next_v = np.empty_like(vpred)
+        next_v[:-1] = np.where(done[:-1], boot[:-1], vpred[1:])
+        next_v[-1] = boot[-1]  # done or fragment cut — both live in boot
+        if use_gae:
+            delta = rew + gamma * next_v - vpred
+            adv = np.empty_like(delta)
+            acc = np.zeros(B, dtype=np.float32)
+            cont = (~done).astype(np.float32) * gamma * lambda_
+            for t in range(T - 1, -1, -1):
+                acc = delta[t] + cont[t] * acc
+                adv[t] = acc
+            targets = adv + vpred
+        else:
+            # Discounted returns bootstrapped at episode ends / fragment cut.
+            ret = np.empty_like(rew)
+            acc = boot[-1]
+            for t in range(T - 1, -1, -1):
+                nxt = boot[t] if t == T - 1 else np.where(done[t], boot[t], acc)
+                acc = rew[t] + gamma * nxt
+                ret[t] = acc
+            adv = ret - vpred
+            targets = ret
+        cols[SampleBatch.ADVANTAGES] = list(adv)
+        cols[SampleBatch.VALUE_TARGETS] = list(targets.astype(np.float32))
+
+    def _values(self, obs: np.ndarray) -> np.ndarray:
+        """V(s) for bootstrap columns — numpy fast path when available."""
+        if self._np_value is not None:
+            return self._np_value(obs)
+        return np.asarray(self._vf_fn(self.module.params, obs))
 
     # -- weights / metrics -------------------------------------------------
 
