@@ -137,35 +137,6 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
-def encode_envelope(value: Any) -> bytes:
-    """Serialize a value into the store's self-contained payload format
-    (header + pickle + out-of-band buffers) on the heap — the cross-node
-    transfer format: a peer daemon put_raw()s these bytes verbatim and its
-    readers get_object() them zero-copy."""
-    buffers: list = []
-    pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
-    raw_bufs = [b.raw() for b in buffers]
-    header = struct.pack(
-        f"<QQ{len(raw_bufs)}Q",
-        len(pickled),
-        len(raw_bufs),
-        *[len(b) for b in raw_bufs],
-    )
-    total = _pad(len(header)) + _pad(len(pickled))
-    for b in raw_bufs:
-        total += _pad(len(b))
-    out = bytearray(total)
-    view = memoryview(out)
-    view[: len(header)] = header
-    pos = _pad(len(header))
-    view[pos : pos + len(pickled)] = pickled
-    pos += _pad(len(pickled))
-    for b in raw_bufs:
-        view[pos : pos + len(b)] = b
-        pos += _pad(len(b))
-    return bytes(out)
-
-
 def envelope_from_pickle(pickled: bytes) -> bytes:
     """Wrap plain cloudpickle bytes in the envelope format (zero out-of-band
     buffers) so they can be put_raw() into a store and get_object()ed back."""
@@ -180,7 +151,7 @@ def envelope_from_pickle(pickled: bytes) -> bytes:
 
 def decode_envelope(view) -> Any:
     """Deserialize a payload in the store's envelope format (the inverse of
-    encode_envelope / NativeStore.put_object)."""
+    NativeStore.put_object's gather-copy layout)."""
     view = memoryview(view).cast("B")
     pickle_len, n_bufs = struct.unpack_from("<QQ", view, 0)
     buf_lens = struct.unpack_from(f"<{n_bufs}Q", view, 16)

@@ -203,7 +203,6 @@ class NodeDaemon:
 
         send_preamble(sock, token, role=b"N")
         self.conn = wire.Connection(sock)
-        self._send_lock = threading.Lock()
 
         if resources is None:
             resources = {}
@@ -245,6 +244,7 @@ class NodeDaemon:
         self._pulls: dict[bytes, threading.Event] = {}
         self._rpc_counter = 0
         self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
+        self._closed = False
 
     @staticmethod
     def _default_budget() -> int:
@@ -287,13 +287,30 @@ class NodeDaemon:
         payload = body["payload"]
         oid = payload["oid"]
         msg_id = body["id"]
+        # A worker that couldn't attach the shm store (or that missed a
+        # local read after an eviction race) asks for the value itself:
+        # never answer {in_native}. Objects already sealed locally are served
+        # as raw envelope bytes (worker decodes them — no daemon-side
+        # unpickle, no double network hop through the head); everything else
+        # forwards to the head so the bytes ride the control plane.
+        if payload.get("force_value") or self.store is None:
+            try:
+                if self.store is not None and self.store.contains(oid):
+                    served = self._serve_bytes(oid)
+                    if served is not None:
+                        worker.reply(
+                            msg_id, ok=True, result={"envelope": served[1]}
+                        )
+                        return
+            except Exception:
+                traceback.print_exc()
+            self.to_head("wf", {"wid": worker.wid, "k": "rpc", "b": body})
+            return
         try:
-            if self.store is not None and self.store.contains(oid):
+            if self.store.contains(oid):
                 worker.reply(msg_id, ok=True, result={"in_native": True})
                 return
-            if self.store is not None and self._pull_into_store(
-                oid, payload.get("timeout")
-            ):
+            if self._pull_into_store(oid, payload.get("timeout")):
                 worker.reply(msg_id, ok=True, result={"in_native": True})
                 return
         except Exception:
@@ -342,6 +359,8 @@ class NodeDaemon:
 
     def head_rpc(self, method: str, payload: dict):
         with self._lock:
+            if self._closed:
+                raise ConnectionError("head connection lost")
             self._rpc_counter += 1
             msg_id = self._rpc_counter
             event = threading.Event()
@@ -349,6 +368,8 @@ class NodeDaemon:
             self._rpc_waiters[msg_id] = (event, slot)
         self.to_head("rpc", {"id": msg_id, "method": method, "payload": payload})
         event.wait(timeout=300)
+        with self._lock:
+            self._rpc_waiters.pop(msg_id, None)
         if slot.get("dead") or not slot:
             raise ConnectionError("head connection lost")
         if slot.get("ok"):
@@ -437,11 +458,23 @@ class NodeDaemon:
             raise SystemExit(0)
 
     def shutdown(self) -> None:
+        # Fail every in-flight head RPC so pulls blocked behind them (and
+        # their deduped followers) unblock immediately instead of eating the
+        # full 300s timeout; _closed makes late registrants fail fast. Lives
+        # here (not in run_forever) so the head-sent "shutdown" SystemExit
+        # path runs it too.
         with self._lock:
+            self._closed = True
+            waiters = list(self._rpc_waiters.values())
+            self._rpc_waiters.clear()
             workers = list(self.workers.values())
             self.workers.clear()
+        for event, slot in waiters:
+            slot["dead"] = True
+            event.set()
         for worker in workers:
             worker.kill()
+        self.rpc_pool.shutdown(wait=False)
         if self.object_server is not None:
             self.object_server.stop()
         self.fetcher.close()

@@ -174,14 +174,12 @@ class ObjectFetcher:
                     raise ConnectionError("peer closed mid-fetch")
                 status, tag, size = _HDR.unpack(hdr)
                 if status != 0:
-                    with self._lock:
-                        self._conns.setdefault(addr, sock)
+                    self._cache_conn(addr, sock)
                     return None
                 data = _recv_exact(sock, size)
                 if data is None:
                     raise ConnectionError("peer closed mid-payload")
-                with self._lock:
-                    self._conns.setdefault(addr, sock)
+                self._cache_conn(addr, sock)
                 return tag, data
             except (OSError, ConnectionError):
                 try:
@@ -193,6 +191,17 @@ class ObjectFetcher:
                     raise
                 # stale cached connection: retry once with a fresh one
         raise ConnectionError(f"unreachable object server {addr}")
+
+    def _cache_conn(self, addr: tuple[str, int], sock: socket.socket) -> None:
+        # One cached connection per peer: the loser of a concurrent fetch
+        # closes its socket instead of leaking the fd.
+        with self._lock:
+            kept = self._conns.setdefault(addr, sock)
+        if kept is not sock:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:

@@ -225,7 +225,14 @@ class WorkerProxyRuntime:
             reply = self.rpc(
                 "get_by_id", {"oid": oid.binary(), "timeout": timeout, "force_value": True}
             )
-        if "value_pickled" in reply:
+        if "envelope" in reply:
+            # Raw store-envelope bytes served by the local node daemon (a
+            # worker without a shm attach still reads node-local objects
+            # without a head round trip).
+            from ray_tpu._private.native_store import decode_envelope
+
+            value = decode_envelope(reply["envelope"])
+        elif "value_pickled" in reply:
             value = cloudpickle.loads(reply["value_pickled"])
         else:
             value = reply["value"]
