@@ -68,7 +68,7 @@ def bench_gpt2(on_tpu: bool) -> None:
         # 24 seqs/chip: measured MXU sweet spot on v5e (8 underfills the
         # [S,E]x[E,V] head matmul; 32 thrashes HBM with the f32 grads of
         # the multi-GB bf16 logits).
-        B, S, warmup, timed = 24 * n_chips, 1024, 3, 10
+        B, S, warmup, timed = 24 * n_chips, 1024, 3, 20
         cfg = gpt2_125m(attention_impl="flash", dtype=jnp.bfloat16)
     else:
         B, S, warmup, timed = 2, 128, 1, 2

@@ -1,0 +1,111 @@
+"""Dissect per-program cost of the flash fwd kernel: start from dots-only
+and add softmax pieces one at a time. Also: two-heads-per-program variant."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, S, H, D = 24, 1024, 12, 64
+BH = B * H
+key = jax.random.PRNGKey(0)
+qf = jax.random.normal(key, (BH, S, D), jnp.bfloat16)
+
+
+def make(level):
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if level >= 1:  # causal mask
+            qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qi >= ki, s, -1e30)
+        if level >= 2:  # rowmax + subtract
+            m = jnp.max(s, axis=1, keepdims=True)
+            s = s - m
+        if level >= 3:  # exp
+            s = jnp.exp(s)
+        if level >= 4:  # rowsum + divide
+            l = jnp.sum(s, axis=1, keepdims=True)
+            s = s / l
+        p = s.astype(v.dtype)
+        o_ref[0] = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    full = lambda b: (b, 0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH,),
+        in_specs=[pl.BlockSpec((1, S, D), full)] * 3,
+        out_specs=pl.BlockSpec((1, S, D), full),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), jnp.bfloat16),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )
+
+
+def make2h(level):
+    """Two heads per program: block (2, S, D)."""
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        for h in range(2):
+            q = q_ref[h]
+            k = k_ref[h]
+            v = v_ref[h]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if level >= 1:
+                qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                ki = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(qi >= ki, s, -1e30)
+            if level >= 3:
+                m = jnp.max(s, axis=1, keepdims=True)
+                s = jnp.exp(s - m)
+                l = jnp.sum(s, axis=1, keepdims=True)
+                s = s / l
+            p = s.astype(v.dtype)
+            o_ref[h] = jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    blk = lambda b: (b, 0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH // 2,),
+        in_specs=[pl.BlockSpec((2, S, D), blk)] * 3,
+        out_specs=pl.BlockSpec((2, S, D), blk),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), jnp.bfloat16),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )
+
+
+def bench(name, f, iters=5):
+    @jax.jit
+    def chained(x):
+        y = x
+        for _ in range(12):
+            y = f(y, y, y)
+        return y
+
+    g = chained(qf)
+    float(g.astype(jnp.float32).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = chained(qf)
+    float(g.astype(jnp.float32).reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:36s} {dt*1e3:8.2f} ms ({dt/12/BH*1e6:5.1f} us/prog)", flush=True)
+
+
+bench("dots only", make(0))
+bench("dots + mask", make(1))
+bench("dots + mask + max", make(2))
+bench("dots + mask + max + exp", make(3))
+bench("full softmax", make(4))
+bench("full softmax, 2 heads/prog", make2h(3))

@@ -1,0 +1,64 @@
+"""Compare our flash kernel against jax.experimental.pallas.ops.tpu's
+flash_attention at GPT-2 bench shapes, plus raw matmul probes at the
+kernel's inner shapes to find the per-program ceiling."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, S, H, D = 24, 1024, 12, 64
+key = jax.random.PRNGKey(0)
+
+
+def bench(name, fn, *args, iters=5):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+    f = jax.tree_util.tree_leaves(out)[0]
+    float(f.reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    f = jax.tree_util.tree_leaves(out)[0]
+    float(f.reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:36s} {dt*1e3:8.2f} ms", flush=True)
+    return dt
+
+
+# jax reference pallas flash attention (layout [B, H, S, D])
+try:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jax_flash, BlockSizes,
+    )
+    qh = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+
+    @jax.jit
+    def jf_fwd(q):
+        y = q
+        for _ in range(12):
+            y = jax_flash(y, y, y, causal=True)
+        return y.astype(jnp.float32).sum()
+
+    bench("jax flash fwd x12", jf_fwd, qh)
+
+    @jax.jit
+    def jf_fwdbwd(q):
+        return jax.grad(lambda t: jf_fwd(t))(q)
+
+    bench("jax flash fwd+bwd x12", jf_fwdbwd, qh)
+except Exception as e:
+    print("jax flash unavailable:", repr(e))
+
+# raw matmul probes at kernel inner shapes, batched like the kernel grid
+a = jax.random.normal(key, (288, 1024, 64), jnp.bfloat16)
+b = jax.random.normal(key, (288, 64, 1024), jnp.bfloat16)
+c = jax.random.normal(key, (288, 1024, 1024), jnp.bfloat16)
+
+bench("QK^T batched [1024,64]x[64,1024]x12",
+      jax.jit(lambda a, b: sum(jnp.einsum("bik,bkj->bij", a, b,
+              preferred_element_type=jnp.float32).astype(jnp.bfloat16).mean()
+              for _ in range(12))), a, b)
+bench("PV batched [1024,1024]x[1024,64]x12",
+      jax.jit(lambda c, a: sum(jnp.einsum("bik,bkj->bij", c, a,
+              preferred_element_type=jnp.float32).astype(jnp.bfloat16).mean()
+              for _ in range(12))), c, a)

@@ -1,0 +1,86 @@
+"""Single-program causal-subtiled flash fwd prototype.
+
+Per program (one [S,D] head): loop q row-blocks; for each, compute scores
+only up to the diagonal (variable-N dot), softmax the row, one PV dot with
+variable K. Causal saves 37.5% of matmul work at T=4 subtiles with no grid
+overhead."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, S, H, D = 24, 1024, 12, 64
+BH = B * H
+key = jax.random.PRNGKey(0)
+qf = jax.random.normal(key, (BH, S, D), jnp.bfloat16)
+
+
+def make_subtiled(T):
+    C = S // T  # q rows per chunk
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        k = k_ref[0]
+        v = v_ref[0]
+        for t in range(T):
+            lim = (t + 1) * C
+            q = q_ref[0, t * C:lim, :]
+            s = jax.lax.dot_general(
+                q, k[:lim, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [C, lim]
+            # mask over the whole row (only the diagonal subtile changes)
+            qi = t * C + jax.lax.broadcasted_iota(jnp.int32, (C, lim), 0)
+            ki = jax.lax.broadcasted_iota(jnp.int32, (C, lim), 1)
+            s = jnp.where(qi >= ki, s, -1e30)
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            o = jax.lax.dot_general(
+                p.astype(v.dtype), v[:lim, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[0, t * C:lim, :] = (o / l).astype(o_ref.dtype)
+            lse_ref[0, :, t * C:lim] = jnp.broadcast_to(
+                (m + jnp.log(l))[:, 0][None, :], (8, C))
+
+    full = lambda b: (b, 0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH,),
+        in_specs=[pl.BlockSpec((1, S, D), full)] * 3,
+        out_specs=[pl.BlockSpec((1, S, D), full),
+                   pl.BlockSpec((1, 8, S), full)],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), jnp.bfloat16),
+                   jax.ShapeDtypeStruct((BH, 8, S), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )
+
+
+def bench(name, f, iters=5):
+    @jax.jit
+    def chained(x):
+        y = x
+        for _ in range(12):
+            y = f(y, y, y)[0]
+        return y
+
+    g = chained(qf)
+    float(g.astype(jnp.float32).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = chained(qf)
+    float(g.astype(jnp.float32).reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:36s} {dt*1e3:8.2f} ms ({dt/12/BH*1e6:5.1f} us/prog)", flush=True)
+
+
+from ray_tpu.ops.flash_attention import _flash_fwd_pallas
+bench("current fwd (grid 1x1 + scratch)",
+      lambda q, k, v: _flash_fwd_pallas(q, k, v, True, 1024, 1024, False))
+for T in (2, 4, 8):
+    try:
+        bench(f"subtiled T={T}", make_subtiled(T))
+    except Exception as e:
+        print(f"T={T} failed: {repr(e)[:200]}", flush=True)

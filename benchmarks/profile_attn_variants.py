@@ -1,0 +1,218 @@
+"""Prototype flash-attention kernel variants at GPT-2 bench shapes.
+
+Variants (fwd+bwd, 12 chained layers per dispatch):
+  current          — repo kernel as-is
+  slim1024         — prescaled q, no redundant select, mask only ops needed
+  slim512-diag     — 512 blocks, diagonal-specialized mask, causal skip
+"""
+import functools
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.flash_attention import (
+    _flash_bwd_pallas, flash_attention as current_flash,
+)
+from ray_tpu.ops.attention import NEG_INF
+
+B, S, H, D = 24, 1024, 12, 64
+_LANES = 128
+
+
+# ------------------------------- slim forward kernel ----------------------
+def _fwd_kernel_slim(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
+    *, causal: bool, block_q: int, block_k: int, num_k: int
+):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # For causal: blocks fully above the diagonal are skipped; blocks fully
+    # below need no mask; only diagonal-crossing blocks (qi*bq < ki*bk+bk)
+    # pay the iota/select cost. q arrives prescaled by sm_scale.
+    needed = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    diag = (qi * block_q < ki * block_k + block_k) if causal else False
+
+    def compute(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if masked:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_prev = m_scratch[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # masked lanes underflow to exactly 0
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scratch[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    if causal:
+        @pl.when(needed & diag)
+        def _masked():
+            compute(True)
+
+        @pl.when(needed & jnp.logical_not(diag))
+        def _plain():
+            compute(False)
+    else:
+        compute(False)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_scratch[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        lse = m_scratch[:, 0] + jnp.log(l[:, 0])
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def slim_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    num_q = s_q // block_q
+    num_k = s_k // block_k
+    kernel = functools.partial(
+        _fwd_kernel_slim, causal=causal, block_q=block_q, block_k=block_k,
+        num_k=num_k,
+    )
+    q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+    kv_map = lambda b, i, j: (b, j, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v)
+
+
+def _fold(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def make_variant(block):
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _fwd(q, k, v)[0]
+
+    sm_scale = 1.0 / math.sqrt(D)
+
+    def _fwd(q, k, v):
+        qf, kf, vf = _fold(q), _fold(k), _fold(v)
+        of, lse = slim_fwd(qf, kf, vf, sm_scale, True, block, block)
+        b, s, h, d = q.shape
+        out = of.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        return out, (qf, kf, vf, of, lse[:, 0, :])
+
+    def _bwd(res, do):
+        qf, kf, vf, of, lse = res
+        b, s, h, d = do.shape
+        dof = _fold(do)
+        delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), -1)
+        pad8 = lambda x: jnp.broadcast_to(x[:, None, :], (x.shape[0], 8, x.shape[1]))
+        dq, dk, dv = _flash_bwd_pallas(
+            qf, kf, vf, dof, pad8(lse), pad8(delta), sm_scale, True,
+            block, block, False,
+        )
+        unf = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        return unf(dq), unf(dk), unf(dv)
+
+    attn.defvjp(_fwd, _bwd)
+    return attn
+
+
+key = jax.random.PRNGKey(0)
+x = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
+
+
+def run(name, fn, iters=5):
+    @jax.jit
+    def chained(x):
+        def f(x):
+            y = x
+            for _ in range(12):
+                y = fn(y)
+            return y.astype(jnp.float32).sum()
+        return jax.grad(f)(x)
+
+    g = chained(x)
+    float(g[0, 0, 0, 0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = chained(x)
+    float(g[0, 0, 0, 0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt*1e3:8.2f} ms", flush=True)
+
+
+run("current 1024", lambda y: current_flash(y, y, y, causal=True))
+v1024 = make_variant(1024)
+run("slimfwd 1024 (old bwd)", lambda y: v1024(y, y, y))
+v512 = make_variant(512)
+run("slimfwd 512 (old bwd)", lambda y: v512(y, y, y))
+v256 = make_variant(256)
+run("slimfwd 256 (old bwd)", lambda y: v256(y, y, y))
+
+# fwd-only comparisons
+def run_fwd(name, fn, iters=5):
+    @jax.jit
+    def chained(x):
+        y = x
+        for _ in range(12):
+            y = fn(y)
+        return y.astype(jnp.float32).sum()
+
+    g = chained(x)
+    float(g)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = chained(x)
+    float(g)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt*1e3:8.2f} ms", flush=True)
+
+
+run_fwd("fwd current 1024", lambda y: current_flash(y, y, y, causal=True))
+run_fwd("fwd slim 1024", lambda y: v1024(y, y, y))
+run_fwd("fwd slim 512", lambda y: v512(y, y, y))
+run_fwd("fwd slim 256", lambda y: v256(y, y, y))

@@ -1,0 +1,44 @@
+"""Batch-size sweep for the GPT-2 bench config with the packed kernel."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import GPT, cross_entropy_loss, gpt2_125m
+
+S = 1024
+cfg = gpt2_125m(attention_impl="flash", dtype=jnp.bfloat16)
+model = GPT(cfg)
+tx = optax.adamw(3e-4)
+key = jax.random.PRNGKey(0)
+
+for B in (24, 28, 32, 40, 48):
+    try:
+        tokens = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+        params = jax.jit(model.init)(key, tokens)
+        opt_state = jax.jit(tx.init)(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, tokens):
+            def loss_fn(p):
+                logits = model.apply(p, tokens)
+                return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        p, o = params, opt_state
+        for _ in range(3):
+            p, o, loss = step(p, o, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            p, o, loss = step(p, o, tokens)
+        float(loss)
+        dt = (time.perf_counter() - t0) / 10
+        print(f"B={B:3d}  {dt*1e3:8.2f} ms  ({B*S/dt:,.0f} tok/s)", flush=True)
+        del p, o, params, opt_state
+    except Exception as e:
+        print(f"B={B} failed: {repr(e)[:150]}", flush=True)

@@ -1,0 +1,63 @@
+"""Differential GPT-2 probes, part 2: attention share, head+loss share,
+optimizer share. Identity attention isolates the dense stack."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import ray_tpu.models.gpt as gpt_mod
+from ray_tpu.models import GPT, cross_entropy_loss, gpt2_125m
+
+B, S = 24, 1024
+real_attention = gpt_mod.attention_op
+
+
+def measure(name, cfg, opt="adamw", head=True, attn="flash", iters=10, warmup=3):
+    gpt_mod.attention_op = (
+        real_attention if attn == "flash" else (lambda q, k, v, **kw: v)
+    )
+    model = GPT(cfg)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+    params = jax.jit(model.init)(key, tokens)
+    tx = optax.adamw(3e-4) if opt == "adamw" else optax.sgd(0.1)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            out = model.apply(p, tokens)
+            if head:
+                return cross_entropy_loss(out[:, :-1], tokens[:, 1:])
+            return out.astype(jnp.float32).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    opt_state = jax.jit(tx.init)(params)
+    p, o = params, opt_state
+    for _ in range(warmup):
+        p, o, loss = step(p, o, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, o, loss = step(p, o, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e3:8.2f} ms  ({B*S/dt:,.0f} tok/s)", flush=True)
+    return dt
+
+
+base = dict(attention_impl="flash", dtype=jnp.bfloat16)
+t12 = measure("12L flash adamw (baseline)", gpt2_125m(**base))
+t12_noattn = measure("12L identity-attn adamw", gpt2_125m(**base), attn="none")
+print(f"  -> attention total (12L fwd+bwd): {(t12-t12_noattn)*1e3:.2f} ms")
+t12_sgd = measure("12L flash sgd", gpt2_125m(**base), opt="sgd")
+print(f"  -> adamw - sgd: {(t12-t12_sgd)*1e3:.2f} ms")
+t12_meanloss = measure("12L flash adamw meanloss", gpt2_125m(**base), head=False)
+print(f"  -> CE loss - mean loss (softmax+bwd only): {(t12-t12_meanloss)*1e3:.2f} ms")
+t12_smallv = measure("12L flash adamw V=768", gpt2_125m(vocab_size=768, **base))
+print(f"  -> head matmul+loss (V=50304 vs 768): {(t12-t12_smallv)*1e3:.2f} ms")
+t0L = measure("0L flash adamw (embed+head only)", gpt2_125m(num_layers=0, **base), iters=20)

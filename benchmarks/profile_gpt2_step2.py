@@ -1,0 +1,81 @@
+"""Full GPT-2 step with new fused-bwd attention kernel; optax.adamw vs
+fused_adamw; single vs multi-step dispatch."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import GPT, cross_entropy_loss, gpt2_125m
+from ray_tpu.ops.fused_optim import fused_adamw
+
+B, S = 24, 1024
+cfg = gpt2_125m(attention_impl="flash", dtype=jnp.bfloat16)
+model = GPT(cfg)
+key = jax.random.PRNGKey(0)
+tokens = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+params = jax.jit(model.init)(key, tokens)
+
+
+def loss_fn(p, tokens):
+    logits = model.apply(p, tokens)
+    return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+
+
+def bench(name, step, p, o, iters=10, warmup=3, steps_per_call=1):
+    for _ in range(warmup):
+        p, o, loss = step(p, o, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, o, loss = step(p, o, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / (iters * steps_per_call)
+    print(f"{name:40s} {dt*1e3:8.2f} ms/step  ({B*S/dt:,.0f} tok/s)", flush=True)
+
+
+tx = optax.adamw(3e-4)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def step_optax(params, opt_state, tokens):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+
+fresh = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: x + 0, p))
+bench("optax adamw, new kernel", step_optax, fresh(params),
+      jax.jit(tx.init)(params))
+
+opt = fused_adamw(3e-4)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def step_fused(params, opt_state, tokens):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+    params, opt_state = opt.apply(grads, opt_state, params)
+    return params, opt_state, loss
+
+
+bench("fused adamw, new kernel", step_fused, fresh(params),
+      jax.jit(opt.init)(params))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def step4(params, opt_state, tokens):
+    def body(carry, _):
+        p, o = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+        p, o = opt.apply(grads, o, p)
+        return (p, o), loss
+
+    (params, opt_state), losses = jax.lax.scan(
+        body, (params, opt_state), None, length=4
+    )
+    return params, opt_state, losses[-1]
+
+
+bench("fused adamw, scan x4 per dispatch", step4, fresh(params),
+      jax.jit(opt.init)(params), iters=3, steps_per_call=4)

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops import attention as attention_op
+from ray_tpu.ops.flash_attention import flash_attention_packed
 from ray_tpu.ops.ring_attention import ring_attention
 
 
@@ -86,15 +87,21 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
         b, s, _ = h.shape
         qkv = _dense(3 * cfg.embed_dim, ("embed", "heads"), cfg.dtype, name="attn_qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        if cfg.attention_impl == "ring":
-            attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+        if cfg.attention_impl == "flash" and s <= 2048:
+            # Packed kernel consumes the projection output directly: no
+            # split / head reshape / fold transposes in the graph, dqkv
+            # comes back packed for the projection's grad matmul.
+            attn = flash_attention_packed(qkv, cfg.num_heads, causal=True)
         else:
-            attn = attention_op(q, k, v, causal=True, impl=cfg.attention_impl)
-        attn = attn.reshape(b, s, cfg.embed_dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            if cfg.attention_impl == "ring":
+                attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+            else:
+                attn = attention_op(q, k, v, causal=True, impl=cfg.attention_impl)
+            attn = attn.reshape(b, s, cfg.embed_dim)
         attn = _dense(cfg.embed_dim, ("heads", "embed"), cfg.dtype, name="attn_proj")(attn)
         x = x + attn
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)

@@ -17,11 +17,17 @@ paid ONCE in the forward; residuals are saved in kernel layout so the
 backward re-reads them directly instead of re-transposing ~125 MB per layer
 (the original scheme's hidden cost at GPT-2 bench shapes).
 
-Backward is two Pallas kernels (dQ accumulating over k-blocks; dK/dV over
-q-blocks) fed by the forward's per-row logsumexp, so neither direction ever
-materializes S×S logits — long-context training stays compute-bound
-(measured on v5e: fwd+bwd at S=8192 is ~10x the full-logits recompute).
-Both skip fully-masked causal blocks' compute the same way.
+Backward: when the whole sequence fits one block (num_q == num_k == 1, the
+GPT-2 bench case), a SINGLE fused kernel computes dQ, dK, and dV in one
+program — one s/p recompute and 5 matmuls instead of the 7 (plus two
+softmax recomputes) of the two-kernel scheme, with delta (rowsum dO·O)
+folded in. Longer sequences use two kernels (dQ accumulating over k-blocks;
+dK/dV over q-blocks) fed by the forward's per-row logsumexp; neither
+direction ever materializes S×S logits, so long-context training stays
+compute-bound (measured on v5e: fwd+bwd at S=8192 is ~10x the full-logits
+recompute). Q arrives at every kernel prescaled by sm_scale (folded into
+surrounding XLA ops), removing the per-element scale passes; dQ is
+rescaled once on its [block, d] output tile.
 
 Net-new vs the reference (no attention kernels exist in Ray); design follows
 the standard flash-attention blockwise algorithm (PAPERS.md) and the Pallas TPU
@@ -46,7 +52,7 @@ _LANES = 128  # TPU lane width: min trailing dim for scratch tiles
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch,
-    *, sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int
+    *, causal: bool, block_q: int, block_k: int, num_k: int
 ):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
@@ -63,27 +69,25 @@ def _fwd_kernel(
 
     @pl.when(needed)
     def _body():
-        q = q_ref[0]  # [block_q, d]
+        q = q_ref[0]  # [block_q, d], prescaled by sm_scale
         k = k_ref[0]  # [block_k, d]
         v = v_ref[0]  # [block_k, d]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
-        s = s * sm_scale
 
         if causal:
             q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_ids >= k_ids
-            s = jnp.where(mask, s, NEG_INF)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
 
         m_prev = m_scratch[:, 0:1]  # [block_q, 1] broadcast column
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
+        # Masked lanes hold NEG_INF: exp underflows to exactly 0, no second
+        # select needed.
         p = jnp.exp(s - m_new)
-        if causal:
-            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
         l_new = l_scratch[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
@@ -107,9 +111,9 @@ def _fwd_kernel(
 
 def _flash_fwd_pallas(
     q: jax.Array, k: jax.Array, v: jax.Array,
-    sm_scale: float, causal: bool, block_q: int, block_k: int, interpret: bool,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
-    """q,k,v: [BH, S, D] (heads folded into batch). Returns (out, lse)."""
+    """q,k,v: [BH, S, D], q prescaled by sm_scale. Returns (out, lse)."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -123,7 +127,6 @@ def _flash_fwd_pallas(
     num_k = s_k // block_k
     kernel = functools.partial(
         _fwd_kernel,
-        sm_scale=sm_scale,
         causal=causal,
         block_q=block_q,
         block_k=block_k,
@@ -191,13 +194,13 @@ def _dq_kernel(
 
     @pl.when(needed)
     def _body():
-        q = q_ref[0]
+        q = q_ref[0]  # prescaled by sm_scale
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
+        )
         if causal:
             q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -206,7 +209,7 @@ def _dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * sm_scale
+        ds = p * (dp - delta_ref[0, 0][:, None])
         acc_scratch[:] = acc_scratch[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -214,7 +217,9 @@ def _dq_kernel(
 
     @pl.when(ki == num_k - 1)
     def _finalize():
-        dq_ref[0] = acc_scratch[:].astype(dq_ref.dtype)
+        # sm_scale applied once on the [block_q, d] tile rather than per
+        # S×S element.
+        dq_ref[0] = (acc_scratch[:] * sm_scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -234,13 +239,13 @@ def _dkv_kernel(
 
     @pl.when(needed)
     def _body():
-        q = q_ref[0]
+        q = q_ref[0]  # prescaled by sm_scale: dS^T @ q_scaled == sm_scale·dS^T @ q
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
+        )
         if causal:
             q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -254,8 +259,8 @@ def _dkv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * sm_scale
-        # dK += dS^T @ Q
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        # dK += dS^T @ Q_scaled (carries the sm_scale factor)
         dk_scratch[:] = dk_scratch[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -265,6 +270,80 @@ def _dkv_kernel(
     def _finalize():
         dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dk_ref, dv_ref,
+    *, sm_scale: float, causal: bool
+):
+    """Whole-sequence backward in ONE program (num_q == num_k == 1): a
+    single s/p recompute feeds dV, dK, and dQ — 5 matmuls vs the two-kernel
+    scheme's 7 — and delta (rowsum dO·O) is computed in-kernel on the
+    [S, d] tiles instead of as a separate XLA op."""
+    q = q_ref[0]  # [s, d], prescaled by sm_scale
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0, 0][:, None])  # masked lanes underflow to 0
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=1, keepdims=True,
+    )
+    ds = p * (dp - delta)
+    ds_lp = ds.astype(q.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds_lp, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dk_ref.dtype)  # q prescaled: carries sm_scale
+    dq = jax.lax.dot_general(
+        ds_lp, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused_pallas(q, k, v, o, do, lse, sm_scale, causal, interpret):
+    """Single-block backward: q,k,v,o,do [BH, S, D]; lse [BH, 8, S]."""
+    bh, s_len, d = q.shape
+    full = lambda b: (b, 0, 0)
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, 8, s_len), full),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+            pl.BlockSpec((1, s_len, d), full),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, d), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
 
 
 def _flash_bwd_pallas(
@@ -336,6 +415,206 @@ def _flash_bwd_pallas(
     return dq, dk, dv
 
 
+# ------------------------------------------------- packed-QKV fast path
+#
+# GPT-style blocks produce one [B, S, 3E] projection; the packed kernels
+# consume it directly — heads are lane-slices inside the kernel, so the
+# split / [B,S,H,D] reshape / fold-unfold transposes vanish from the graph
+# (~600 MB/layer of pure layout traffic at GPT-2 bench shapes), and the
+# backward emits dqkv [B, S, 3E] ready for the projection's grad matmul.
+# One program per batch row; causal work is subtiled in halves so the
+# strictly-above-diagonal quarter of every matmul is skipped with no grid
+# overhead (everything stays VMEM-resident).
+
+
+def _packed_fwd_kernel(qkv_ref, o_ref, lse_ref, *, heads: int, dim: int,
+                       sm_scale: float, causal: bool, n_sub: int):
+    s_len = o_ref.shape[1]
+    embed = heads * dim
+    C = s_len // n_sub
+    for h in range(heads):
+        k = qkv_ref[0, :, embed + h * dim:embed + (h + 1) * dim]
+        v = qkv_ref[0, :, 2 * embed + h * dim:2 * embed + (h + 1) * dim]
+        for t in range(n_sub):
+            lim = (t + 1) * C if causal else s_len
+            rows = slice(t * C, (t + 1) * C)
+            q = qkv_ref[0, rows, h * dim:(h + 1) * dim]
+            q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+            s = jax.lax.dot_general(
+                q, k[:lim, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [C, lim]
+            if causal:
+                qi = t * C + jax.lax.broadcasted_iota(jnp.int32, (C, lim), 0)
+                ki = jax.lax.broadcasted_iota(jnp.int32, (C, lim), 1)
+                s = jnp.where(qi >= ki, s, NEG_INF)
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            o = jax.lax.dot_general(
+                p.astype(v.dtype), v[:lim, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[0, rows, h * dim:(h + 1) * dim] = (o / l).astype(o_ref.dtype)
+            lse_ref[0, h, t * C:(t + 1) * C] = (m + jnp.log(l))[:, 0]
+
+
+def _packed_bwd_kernel(qkv_ref, o_ref, do_ref, lse_ref, dqkv_ref,
+                       *, heads: int, dim: int, sm_scale: float,
+                       causal: bool, n_sub: int):
+    s_len = o_ref.shape[1]
+    embed = heads * dim
+    C = s_len // n_sub
+    for h in range(heads):
+        k = qkv_ref[0, :, embed + h * dim:embed + (h + 1) * dim]
+        v = qkv_ref[0, :, 2 * embed + h * dim:2 * embed + (h + 1) * dim]
+        do_h = do_ref[0, :, h * dim:(h + 1) * dim]
+        dk_parts = []
+        dv_parts = []
+        for t in range(n_sub):
+            lim = (t + 1) * C if causal else s_len
+            rows = slice(t * C, (t + 1) * C)
+            q = qkv_ref[0, rows, h * dim:(h + 1) * dim]
+            q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+            do_r = do_h[rows, :]
+            s = jax.lax.dot_general(
+                q, k[:lim, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if causal:
+                qi = t * C + jax.lax.broadcasted_iota(jnp.int32, (C, lim), 0)
+                ki = jax.lax.broadcasted_iota(jnp.int32, (C, lim), 1)
+                s = jnp.where(qi >= ki, s, NEG_INF)
+            lse_r = lse_ref[0, h, t * C:(t + 1) * C]
+            p = jnp.exp(s - lse_r[:, None])  # masked lanes underflow to 0
+            dp = jax.lax.dot_general(
+                do_r, v[:lim, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            delta = jnp.sum(
+                do_r.astype(jnp.float32)
+                * o_ref[0, rows, h * dim:(h + 1) * dim].astype(jnp.float32),
+                axis=1, keepdims=True)
+            ds = p * (dp - delta)
+            p_lp = p.astype(do_r.dtype)
+            ds_lp = ds.astype(q.dtype)
+            dq = jax.lax.dot_general(
+                ds_lp, k[:lim, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dqkv_ref[0, rows, h * dim:(h + 1) * dim] = (
+                dq * sm_scale).astype(dqkv_ref.dtype)
+            # dV[:lim] += P^T dO_r ; dK[:lim] += dS^T Q_scaled (carries scale)
+            dv_parts.append(jax.lax.dot_general(
+                p_lp, do_r, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            dk_parts.append(jax.lax.dot_general(
+                ds_lp, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+
+        def _accumulate(parts):
+            # parts[t] covers k rows [0, lim_t); sum overlapping prefixes
+            # (n_sub is 1 or 2, so this is one concat at most).
+            total = parts[-1]
+            for part in parts[:-1]:
+                r = part.shape[0]
+                total = jnp.concatenate(
+                    [total[:r, :] + part, total[r:, :]], axis=0)
+            return total
+
+        dqkv_ref[0, :, embed + h * dim:embed + (h + 1) * dim] = (
+            _accumulate(dk_parts).astype(dqkv_ref.dtype))
+        dqkv_ref[0, :, 2 * embed + h * dim:2 * embed + (h + 1) * dim] = (
+            _accumulate(dv_parts).astype(dqkv_ref.dtype))
+
+
+def _packed_n_sub(s_len: int, causal: bool) -> int:
+    # Halves measured fastest on v5e at S=1024: 25% of matmul work skipped
+    # with only one extra subtile loop iteration (quarters save 37.5% of
+    # the FLOPs but lose more to loop overhead).
+    return 2 if (causal and s_len % 2 == 0 and s_len >= 512) else 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _packed_flash(qkv, heads, sm_scale, causal):
+    return _packed_fwd(qkv, heads, sm_scale, causal)[0]
+
+
+def _packed_fwd(qkv, heads, sm_scale, causal):
+    b, s_len, three_e = qkv.shape
+    embed = three_e // 3
+    dim = embed // heads
+    n_sub = _packed_n_sub(s_len, causal)
+    kernel = functools.partial(
+        _packed_fwd_kernel, heads=heads, dim=dim, sm_scale=sm_scale,
+        causal=causal, n_sub=n_sub)
+    full = lambda i: (i, 0, 0)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s_len, three_e), full)],
+        out_specs=[pl.BlockSpec((1, s_len, embed), full),
+                   pl.BlockSpec((1, heads, s_len), full)],
+        out_shape=[jax.ShapeDtypeStruct((b, s_len, embed), qkv.dtype),
+                   jax.ShapeDtypeStruct((b, heads, s_len), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=_on_cpu(),
+    )(qkv)
+    return out, (qkv, out, lse)
+
+
+def _packed_bwd(heads, sm_scale, causal, residuals, do):
+    qkv, out, lse = residuals
+    b, s_len, three_e = qkv.shape
+    embed = three_e // 3
+    dim = embed // heads
+    n_sub = _packed_n_sub(s_len, causal)
+    kernel = functools.partial(
+        _packed_bwd_kernel, heads=heads, dim=dim, sm_scale=sm_scale,
+        causal=causal, n_sub=n_sub)
+    full = lambda i: (i, 0, 0)
+    dqkv = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s_len, three_e), full),
+                  pl.BlockSpec((1, s_len, embed), full),
+                  pl.BlockSpec((1, s_len, embed), full),
+                  pl.BlockSpec((1, heads, s_len), full)],
+        out_specs=pl.BlockSpec((1, s_len, three_e), full),
+        out_shape=jax.ShapeDtypeStruct((b, s_len, three_e), qkv.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=_on_cpu(),
+    )(qkv, out, do, lse)
+    return (dqkv,)
+
+
+_packed_flash.defvjp(_packed_fwd, _packed_bwd)
+
+
+def flash_attention_packed(
+    qkv: jax.Array,
+    num_heads: int,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Flash attention on a packed [B, S, 3*E] qkv projection → [B, S, E].
+
+    The fastest path for standard transformer blocks: heads are sliced
+    inside the kernel (no split/reshape/transpose ops in the graph) and the
+    backward returns dqkv in the same packed layout. Sequences longer than
+    ~2048 should use `flash_attention` (blockwise-pipelined) or ring
+    attention instead — the packed kernels hold a full [S, S/2] score tile
+    in VMEM."""
+    b, s_len, three_e = qkv.shape
+    if three_e % (3 * num_heads):
+        raise ValueError(f"qkv last dim {three_e} not divisible by 3*heads")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(three_e // (3 * num_heads))
+    return _packed_flash(qkv, num_heads, sm_scale, causal)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
@@ -355,32 +634,44 @@ def _unfold_heads(x, b, h):
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
     b, s, h, d = q.shape
+    # Prescale q once on the [B,S,H,D] tensor (XLA fuses this into the
+    # producing matmul's epilogue in real models): every kernel then skips
+    # its per-S×S-element scale pass.
+    q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
     q_f, k_f, v_f = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     out_f, lse = _flash_fwd_pallas(
-        q_f, k_f, v_f, sm_scale, causal, block_q, block_k, interpret=_on_cpu()
+        q_f, k_f, v_f, causal, block_q, block_k, interpret=_on_cpu()
     )
     out = _unfold_heads(out_f, b, h)
-    # Residuals stay in kernel layout: the backward reads them directly
-    # instead of paying the fold transposes a second time.
+    # Residuals stay in kernel layout (q_f prescaled): the backward reads
+    # them directly instead of paying the fold transposes a second time.
     return out, (q_f, k_f, v_f, out_f, lse[:, 0, :])
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, residuals, do):
-    """Flash backward: two Pallas kernels (dQ over k-blocks; dK/dV over
-    q-blocks) using the forward's per-row logsumexp — no S×S logits are ever
-    materialized, so long-context training is compute-bound like the fwd."""
+    """Flash backward using the forward's per-row logsumexp — no S×S logits
+    are ever materialized. Single-block sequences take the fused one-kernel
+    path; longer ones the two-kernel (dQ over k-blocks; dK/dV over q-blocks)
+    scheme."""
     q_f, k_f, v_f, out_f, lse = residuals
     b, _, h, _ = do.shape
     do_f = _fold_heads(do)
-    # delta_i = sum_d dO_i · O_i (rowwise), f32.
-    delta = jnp.sum(
-        do_f.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1
-    )
     pad8 = lambda x: jnp.broadcast_to(x[:, None, :], (x.shape[0], 8, x.shape[1]))
-    dq, dk, dv = _flash_bwd_pallas(
-        q_f, k_f, v_f, do_f, pad8(lse), pad8(delta),
-        sm_scale, causal, block_q, block_k, interpret=_on_cpu(),
-    )
+    s_len = q_f.shape[1]
+    if min(block_q, s_len) == s_len == k_f.shape[1] == min(block_k, s_len):
+        dq, dk, dv = _flash_bwd_fused_pallas(
+            q_f, k_f, v_f, out_f, do_f, pad8(lse),
+            sm_scale, causal, interpret=_on_cpu(),
+        )
+    else:
+        # delta_i = sum_d dO_i · O_i (rowwise), f32.
+        delta = jnp.sum(
+            do_f.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1
+        )
+        dq, dk, dv = _flash_bwd_pallas(
+            q_f, k_f, v_f, do_f, pad8(lse), pad8(delta),
+            sm_scale, causal, block_q, block_k, interpret=_on_cpu(),
+        )
     return (
         _unfold_heads(dq, b, h),
         _unfold_heads(dk, b, h),

@@ -1,0 +1,98 @@
+"""Single-pass fused optimizers (optax-compatible).
+
+optax.adamw is a chain of three GradientTransformations followed by
+apply_updates — four logical passes over every parameter leaf. XLA fuses
+much of it, but the measured step cost on v5e was ~3.5x the HBM roofline
+(read p,g,mu,nu + write p,mu,nu ~= 3.5 GB for 125M f32 params). These
+implementations compute moments, bias correction, weight decay, and the
+parameter update in ONE tree_map per leaf so the whole update is a single
+elementwise fusion per parameter, and expose an `apply` entry point that
+returns updated params directly (no separate apply_updates pass).
+
+Drop-in: `fused_adamw(lr).init/update` follow the optax API (update returns
+(updates, state) with updates = new_params - params when params given), but
+the fast path is `fused_adamw(lr).apply(grads, state, params) ->
+(new_params, new_state)`.
+
+Reference parity: optax.adamw semantics (the reference's torch.optim.AdamW
+analog used throughout ray.train examples, e.g.
+python/ray/train/examples/pytorch/torch_fashion_mnist_example.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class FusedAdamWState(NamedTuple):
+    count: jax.Array  # int32 step counter
+    mu: any
+    nu: any
+
+
+class FusedOptimizer(NamedTuple):
+    init: any
+    update: any
+    apply: any
+
+
+def fused_adamw(
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-4,
+) -> FusedOptimizer:
+    """AdamW with decoupled weight decay, one fused pass per leaf."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p)
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def _step(g, p, mu, nu, c1, c2):
+        # One elementwise chain: mu', nu', m_hat, v_hat, update, decay, p'.
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        mu_new = b1 * mu + (1.0 - b1) * g32
+        nu_new = b2 * nu + (1.0 - b2) * jnp.square(g32)
+        m_hat = mu_new / c1
+        v_hat = nu_new / c2
+        p_new = p32 - learning_rate * (
+            m_hat / (jnp.sqrt(v_hat) + eps) + weight_decay * p32
+        )
+        return p_new.astype(p.dtype), mu_new, nu_new
+
+    def apply(grads, state, params):
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_p = treedef.flatten_up_to(params)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = [
+            _step(g, p, mu, nu, c1, c2)
+            for g, p, mu, nu in zip(flat_g, flat_p, flat_mu, flat_nu)
+        ]
+        unflat = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in out]
+        )
+        return unflat(0), FusedAdamWState(count=count, mu=unflat(1), nu=unflat(2))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adamw.update requires params")
+        new_params, new_state = apply(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, p: n - p, new_params, params
+        )
+        return updates, new_state
+
+    return FusedOptimizer(init=init, update=update, apply=apply)

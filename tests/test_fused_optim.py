@@ -1,0 +1,44 @@
+"""fused_adamw must match optax.adamw step-for-step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.ops.fused_optim import fused_adamw
+
+
+def test_fused_adamw_matches_optax():
+    params = {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4) / 10.0,
+        "b": jnp.ones((4,), jnp.float32),
+    }
+    key = jax.random.PRNGKey(0)
+
+    tx = optax.adamw(1e-2, weight_decay=1e-4)
+    fo = fused_adamw(1e-2, weight_decay=1e-4)
+    state_o = tx.init(params)
+    state_f = fo.init(params)
+    p_o = p_f = params
+    for i in range(5):
+        key, sub = jax.random.split(key)
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(sub, p.shape), p_o
+        )
+        updates, state_o = tx.update(grads, state_o, p_o)
+        p_o = optax.apply_updates(p_o, updates)
+        p_f, state_f = fo.apply(grads, state_f, p_f)
+    for a, b in zip(jax.tree_util.tree_leaves(p_o), jax.tree_util.tree_leaves(p_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_adamw_update_api():
+    params = {"w": jnp.ones((2, 2), jnp.float32)}
+    fo = fused_adamw(1e-1)
+    state = fo.init(params)
+    grads = {"w": jnp.full((2, 2), 0.5, jnp.float32)}
+    updates, state = fo.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    p_direct, _ = fused_adamw(1e-1).apply(grads, fo.init(params), params)
+    np.testing.assert_allclose(
+        np.asarray(new["w"]), np.asarray(p_direct["w"]), rtol=1e-6
+    )
