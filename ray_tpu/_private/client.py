@@ -60,6 +60,10 @@ class ClientCore:
         self._rpc_lock = threading.Lock()
         self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
         self.closed = False
+        # Whether head-pushed worker log batches are reprinted locally (the
+        # log_to_driver analog for remote drivers; `ray-tpu logs` turns it
+        # off to avoid double-printing the rows it polls itself).
+        self.print_pushed_logs = True
         self._reader = threading.Thread(
             target=self._read_loop, name="client-reader", daemon=True
         )
@@ -150,6 +154,18 @@ class ClientCore:
                     self.conn.send("pong", {"id": body.get("id")})
                 except Exception:
                     break
+            elif kind == "log":
+                # Worker log batch pushed by the head: reprint with the
+                # (pid, node) prefix, the worker.py print_logs analog.
+                if self.print_pushed_logs:
+                    try:
+                        from ray_tpu._private.log_aggregation import (
+                            print_batch_to_driver,
+                        )
+
+                        print_batch_to_driver(body)
+                    except Exception:
+                        pass
         self._fail_all()
 
     def _fail_all(self) -> None:

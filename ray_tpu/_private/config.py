@@ -94,6 +94,11 @@ class Config:
     idle_worker_killing_time_s: float = 60.0
     # Logging
     log_to_driver: bool = True
+    # Web dashboard (dashboard/head.py): started by init() when enabled.
+    # Port 0 picks an ephemeral port (tests); the reference defaults to 8265.
+    include_dashboard: bool = False
+    dashboard_host: str = "127.0.0.1"
+    dashboard_port: int = 8265
 
     def __post_init__(self):
         for f in fields(self):

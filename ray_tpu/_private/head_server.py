@@ -70,6 +70,17 @@ class ClientHandle(WirePeer):
         # Each client acts as a driver task of the head's job: its submitted
         # tasks parent under a fresh driver task id.
         self.driver_task_id = TaskID.for_job(runtime.job_id)
+        # Log pushes ride a bounded queue + dedicated sender thread: a
+        # stalled client (full TCP buffer) drops its own log batches instead
+        # of blocking the appending thread (which for remote-node logs is
+        # that node's frame-reader — a stall there would freeze task results
+        # from the whole node).
+        import queue as _queue
+
+        self._log_q: "_queue.Queue" = _queue.Queue(maxsize=256)
+        self._log_sender = threading.Thread(
+            target=self._send_logs_loop, name="client-logpush", daemon=True
+        )
         native = runtime._native_store
         conn.send(
             "hello",
@@ -95,6 +106,23 @@ class ClientHandle(WirePeer):
         """Begin serving; called AFTER the server registered this handle so
         an instantly-dying connection's forget() can actually remove it."""
         self._reader.start()
+        self._log_sender.start()
+
+    def push_log(self, batch: dict) -> None:
+        try:
+            self._log_q.put_nowait(batch)
+        except Exception:
+            pass  # queue full: drop the batch for this viewer
+
+    def _send_logs_loop(self) -> None:
+        while True:
+            batch = self._log_q.get()
+            if batch is None:
+                return
+            try:
+                self.conn.send("log", batch)
+            except Exception:
+                return  # reader thread owns disconnect handling
 
     def _read_loop(self) -> None:
         while True:
@@ -130,6 +158,10 @@ class ClientHandle(WirePeer):
                 traceback.print_exc()
         self._drop_all_borrows()
         self.server.forget(self)
+        try:
+            self._log_q.put_nowait(None)  # release the log sender thread
+        except Exception:
+            pass
         self.conn.close()
 
 
@@ -168,10 +200,19 @@ class HeadServer:
         self._clients: set[ClientHandle] = set()
         self._lock = threading.Lock()
         self._running = True
+        # Fan worker log batches out to every connected remote driver (the
+        # head's own driver printing is a separate sink on the same buffer).
+        runtime.logs.add_sink(self._fanout_logs)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="head-accept", daemon=True
         )
         self._accept_thread.start()
+
+    def _fanout_logs(self, batch: dict) -> None:
+        with self._lock:
+            clients = list(self._clients)
+        for handle in clients:
+            handle.push_log(batch)
 
     @property
     def address(self) -> str:
@@ -238,6 +279,10 @@ class HeadServer:
 
     def stop(self) -> None:
         self._running = False
+        try:
+            self.runtime.logs.remove_sink(self._fanout_logs)
+        except Exception:
+            pass
         try:
             self._listener.close()
         except OSError:

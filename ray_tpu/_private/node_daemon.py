@@ -65,8 +65,19 @@ class DaemonWorker:
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             pass_fds=[child_sock.fileno()],
             env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
         )
         child_sock.close()
+        # Tail the worker's stdout/stderr and ship line batches to the head
+        # ("wl" frames): the reference's log_monitor → pubsub → driver path
+        # (python/ray/_private/log_monitor.py:102) collapsed onto the
+        # existing node connection.
+        from ray_tpu._private.log_aggregation import PipeTailer
+
+        for stream, pipe in (("stdout", self.proc.stdout),
+                             ("stderr", self.proc.stderr)):
+            PipeTailer(pipe.fileno(), stream, self._emit_log).start()
         self.conn = wire.Connection(parent_sock)
         self.conn.send(
             "hello",
@@ -125,6 +136,20 @@ class DaemonWorker:
         except Exception:
             pass
         self.daemon.on_worker_exit(self)
+
+    def _emit_log(self, stream: str, lines: list) -> None:
+        try:
+            self.daemon.to_head(
+                "wl",
+                {
+                    "wid": self.wid,
+                    "pid": self.proc.pid,
+                    "stream": stream,
+                    "lines": lines,
+                },
+            )
+        except Exception:
+            pass  # head gone: fate-sharing will tear us down shortly
 
     def send_frame_bytes(self, payload: bytes) -> None:
         self.conn.send_bytes(payload)

@@ -18,6 +18,7 @@ Selected with config flag `isolation="process"` (env RAY_TPU_ISOLATION).
 
 from __future__ import annotations
 
+import itertools
 import os
 import socket
 import subprocess
@@ -238,6 +239,16 @@ class WirePeer:
         if method == "cancel":
             ref = ObjectRef(ObjectID(payload["oid"]))
             return runtime.cancel(ref, force=payload.get("force", False))
+        if method == "get_logs":
+            return {
+                "rows": runtime.logs.tail(
+                    node_id=payload.get("node_id"),
+                    wid=payload.get("wid"),
+                    pid=payload.get("pid"),
+                    after_seq=payload.get("after_seq"),
+                    limit=payload.get("limit", 1000),
+                )
+            }
         raise ValueError(f"unknown RPC method {method!r}")
 
     def _reply_refs(self, out: list, options: dict) -> dict:
@@ -306,11 +317,17 @@ class WorkerChannel(WirePeer):
         raise NotImplementedError
 
 
+_LOCAL_WID = itertools.count(1)
+
+
 class ProcessWorkerHandle(WorkerChannel):
     """One worker process: socket, reader thread, in-flight tasks, borrows."""
 
     def __init__(self, engine: "ProcessNodeEngine"):
         super().__init__(engine)
+        # Small stable worker id for the log plane (daemon workers get wids
+        # from their node; pids are recorded separately).
+        self.wid = next(_LOCAL_WID)
         parent_sock, child_sock = socket.socketpair()
         env = os.environ.copy()
         env["RAY_TPU_WORKER_FD"] = str(child_sock.fileno())
@@ -327,8 +344,17 @@ class ProcessWorkerHandle(WorkerChannel):
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             pass_fds=[child_sock.fileno()],
             env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
         )
         child_sock.close()
+        # Same-machine workers go through the log plane too, so driver
+        # output carries (pid, node) prefixes and `ray-tpu logs` sees them.
+        from ray_tpu._private.log_aggregation import PipeTailer
+
+        for stream, pipe in (("stdout", self.proc.stdout),
+                             ("stderr", self.proc.stderr)):
+            PipeTailer(pipe.fileno(), stream, self._emit_log).start()
         self.conn = wire.Connection(parent_sock)
         native = self.runtime._native_store
         self.conn.send(
@@ -349,6 +375,19 @@ class ProcessWorkerHandle(WorkerChannel):
             target=self._read_loop, name=f"pworker-{self.proc.pid}", daemon=True
         )
         self._reader.start()
+
+    def _emit_log(self, stream: str, lines: list) -> None:
+        try:
+            self.runtime.logs.append(
+                node_id=self.engine.node.node_id.hex(),
+                hostname="local",
+                wid=self.wid,
+                pid=self.proc.pid,
+                stream=stream,
+                lines=lines,
+            )
+        except Exception:
+            pass
 
     # -- sending tasks -----------------------------------------------------
 

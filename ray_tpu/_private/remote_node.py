@@ -19,6 +19,7 @@ Frame protocol over the node connection (all cloudpickle frames, wire.py):
   daemon -> head:
     register_node {...}           first frame (handled by accept_node)
     wf {wid, k, b}                frame from worker wid (decoded by daemon)
+    wl {wid, pid, stream, lines}  worker stdout/stderr line batch
     worker_exit {wid}             a worker process died
     rpc {id, method, payload}     daemon-level RPC (locate_object)
     pong {id}
@@ -196,6 +197,16 @@ class NodeHandle:
                 handle._on_disconnect()
                 return
             handle._handle_frame(body["k"], body["b"])
+        elif kind == "wl":
+            # Worker log lines tailed by the daemon (log_aggregation.py).
+            self.runtime.logs.append(
+                node_id=self.node_id.hex(),
+                hostname=self.hostname,
+                wid=body["wid"],
+                pid=body.get("pid", 0),
+                stream=body["stream"],
+                lines=body["lines"],
+            )
         elif kind == "worker_exit":
             with self._lock:
                 handle = self._workers.pop(body["wid"], None)

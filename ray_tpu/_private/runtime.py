@@ -229,6 +229,17 @@ class Runtime:
         from ray_tpu._private.task_events import TaskEventBuffer
 
         self.task_events = TaskEventBuffer()
+        # Cross-node worker log plane: daemon/engine pipe tails feed this
+        # ring; sinks reprint on the driver and fan out to remote clients
+        # (reference: log_monitor.py → pubsub → worker.py print_logs).
+        from ray_tpu._private.log_aggregation import (
+            LogBuffer,
+            print_batch_to_driver,
+        )
+
+        self.logs = LogBuffer()
+        if self.config.log_to_driver:
+            self.logs.add_sink(print_batch_to_driver)
         from ray_tpu._private.runtime_env import RuntimeEnvManager
 
         self.runtime_env_manager = RuntimeEnvManager()
@@ -262,6 +273,17 @@ class Runtime:
         _RUNTIME = self
         if resources is not None:
             self.add_node(resources, is_head=True)
+        # Web dashboard (dashboard/head.py): read-only HTTP over the state
+        # sources above (reference: dashboard/head.py module autoload).
+        self.dashboard = None
+        if self.config.include_dashboard:
+            from ray_tpu.dashboard import start_dashboard
+
+            self.dashboard = start_dashboard(
+                self,
+                host=self.config.dashboard_host,
+                port=self.config.dashboard_port,
+            )
         if self._gcs_storage is not None:
             from ray_tpu._private.gcs_storage import restore_snapshot
 
@@ -1328,6 +1350,9 @@ class Runtime:
 
     def shutdown(self) -> None:
         global _RUNTIME
+        if getattr(self, "dashboard", None) is not None:
+            self.dashboard.stop()
+            self.dashboard = None
         if getattr(self, "_head_server", None) is not None:
             try:
                 self._head_server.stop()

@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.head import DashboardServer, start_dashboard
+
+__all__ = ["DashboardServer", "start_dashboard"]

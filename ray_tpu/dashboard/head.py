@@ -1,0 +1,202 @@
+"""Head-node web dashboard: JSON state APIs + one static HTML page.
+
+The reference ships a 25k-line aiohttp + React dashboard
+(dashboard/head.py:200-215 autoloads module subclasses; the TS frontend
+renders GCS state). Everything it displays already exists here as Python
+state — controller tables, task events, the log buffer, prometheus text —
+so the TPU-native dashboard is a thin read-only HTTP layer over those
+sources plus a single self-contained HTML page (no build step, no node_modules;
+the page polls the JSON endpoints).
+
+Endpoints:
+  GET /                      HTML overview (auto-refreshing)
+  GET /api/cluster           summary: nodes, resources, job, uptime
+  GET /api/nodes             state API list_nodes
+  GET /api/tasks[?limit=]    state API list_tasks
+  GET /api/actors            state API list_actors
+  GET /api/objects           state API list_objects
+  GET /api/placement_groups  state API list_placement_groups
+  GET /api/task_summary      per-(name,state) counts
+  GET /api/logs[?node_id=&wid=&after_seq=&limit=]   log buffer tail
+  GET /api/timeline          chrome://tracing JSON of task events
+  GET /metrics               prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_START = time.time()
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray-tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.4rem}
+ table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
+ th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
+ th{background:#f0f0f0} .mono{font-family:ui-monospace,monospace}
+ #cluster{background:#fff;border:1px solid #ddd;padding:.6rem 1rem}
+ .ok{color:#0a7d33}.bad{color:#c22}
+</style></head><body>
+<h1>ray-tpu dashboard</h1>
+<div id="cluster">loading…</div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Task summary</h2><table id="tasks"></table>
+<h2>Recent logs</h2><pre id="logs" class="mono"
+  style="background:#fff;border:1px solid #ddd;padding:.6rem;max-height:20rem;overflow:auto"></pre>
+<script>
+async function j(u){const r=await fetch(u);return r.json()}
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+  .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
+function fill(id, rows, cols){
+  const t=document.getElementById(id);
+  if(!rows.length){t.innerHTML='<tr><td>none</td></tr>';return}
+  cols=cols||Object.keys(rows[0]);
+  t.innerHTML='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>'+
+    rows.map(r=>'<tr>'+cols.map(c=>'<td>'+esc(JSON.stringify(r[c]??''))+'</td>').join('')+'</tr>').join('');
+}
+async function refresh(){
+  try{
+    const c=await j('/api/cluster');
+    document.getElementById('cluster').innerHTML=
+      `job <b class=mono>${c.job_id}</b> · ${c.alive_nodes}/${c.nodes} nodes alive · `+
+      `uptime ${c.uptime_s.toFixed(0)}s · resources `+
+      `<span class=mono>${JSON.stringify(c.resources_available)}</span> / `+
+      `<span class=mono>${JSON.stringify(c.resources_total)}</span>`;
+    fill('nodes', await j('/api/nodes'),
+         ['node_id','state','resources_total','resources_available','is_head_node']);
+    fill('actors', await j('/api/actors'),
+         ['actor_id','class_name','state','name','num_restarts']);
+    const s=await j('/api/task_summary');
+    fill('tasks', Object.entries(s).map(([k,v])=>({task:k,count:v})));
+    const logs=await j('/api/logs?limit=200');
+    document.getElementById('logs').textContent=
+      logs.map(l=>`(pid=${l.pid}, node=${l.hostname}) ${l.line}`).join('\\n');
+  }catch(e){document.getElementById('cluster').innerHTML=
+      '<span class=bad>refresh failed: '+e+'</span>'}
+  setTimeout(refresh, 2000);
+}
+refresh();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ray-tpu-dashboard"
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj) -> None:
+        self._send(200, json.dumps(obj, default=str).encode(), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            self._route()
+        except BrokenPipeError:
+            pass
+        except Exception as exc:  # surface handler bugs as 500s, not hangs
+            try:
+                self._send(500, repr(exc).encode(), "text/plain")
+            except Exception:
+                pass
+
+    def _route(self) -> None:
+        from ray_tpu.util.state import api as state
+        from ray_tpu.util import metrics
+
+        runtime = self.server.runtime  # type: ignore[attr-defined]
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        path = parsed.path
+        limit = int(q.get("limit", 1000))
+        if path == "/":
+            self._send(200, _PAGE.encode(), "text/html")
+        elif path == "/api/cluster":
+            nodes = list(runtime.controller.nodes.values())
+            total: dict = {}
+            avail: dict = {}
+            for node in nodes:
+                for key, val in node.total.items():
+                    total[key] = total.get(key, 0) + val
+                for key, val in node.available.items():
+                    avail[key] = avail.get(key, 0) + val
+            self._json(
+                {
+                    "job_id": runtime.job_id.hex(),
+                    "nodes": len(nodes),
+                    "alive_nodes": sum(node.alive for node in nodes),
+                    "resources_total": total,
+                    "resources_available": avail,
+                    "uptime_s": time.time() - _START,
+                }
+            )
+        elif path == "/api/nodes":
+            self._json(state.list_nodes(limit=limit))
+        elif path == "/api/tasks":
+            self._json(state.list_tasks(limit=limit))
+        elif path == "/api/actors":
+            self._json(state.list_actors(limit=limit))
+        elif path == "/api/objects":
+            self._json(state.list_objects(limit=limit))
+        elif path == "/api/placement_groups":
+            self._json(state.list_placement_groups(limit=limit))
+        elif path == "/api/task_summary":
+            self._json(state.summarize_tasks())
+        elif path == "/api/logs":
+            self._json(
+                runtime.logs.tail(
+                    node_id=q.get("node_id"),
+                    wid=int(q["wid"]) if "wid" in q else None,
+                    after_seq=int(q["after_seq"]) if "after_seq" in q else None,
+                    limit=limit,
+                )
+            )
+        elif path == "/api/timeline":
+            self._json(runtime.task_events.chrome_trace())
+        elif path == "/metrics":
+            self._send(200, metrics.prometheus_text().encode(), "text/plain")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+
+class DashboardServer:
+    """Threaded HTTP server bound to the head; read-only over runtime state."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 8265):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.runtime = runtime  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+def start_dashboard(runtime, host: str = "127.0.0.1", port: int = 8265) -> DashboardServer:
+    return DashboardServer(runtime, host, port)

@@ -107,6 +107,75 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Tail aggregated worker logs (reference: `ray logs` +
+    log_monitor-fed dashboard log view). With --address, queries a running
+    head over the client protocol; without, there is no persistent cluster
+    to read from, so --address is required."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private.runtime import get_runtime
+
+    ray_tpu.init(address=args.address)
+    runtime = get_runtime()
+    # This command polls get_logs itself; pushed batches would double-print.
+    runtime._client_core.print_pushed_logs = False
+    after = 0
+    try:
+        while True:
+            reply = runtime._client_core.rpc(
+                "get_logs",
+                {
+                    "node_id": args.node_id,
+                    "wid": args.wid,
+                    "after_seq": after,
+                    "limit": 1000,
+                },
+            )
+            rows = reply["rows"]
+            for row in rows:
+                after = max(after, row["seq"])
+                print(
+                    f"(wid={row['wid']} pid={row['pid']}, "
+                    f"node={row['hostname']}) [{row['stream']}] {row['line']}"
+                )
+            if not args.follow:
+                break
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    """Serve the web dashboard for a local demo runtime (when a head runs
+    in-process, init(include_dashboard=True) serves it from the head
+    itself)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private.runtime import get_runtime
+
+    ray_tpu.init(
+        num_cpus=getattr(args, "num_cpus", None) or 8,
+        _system_config={
+            "include_dashboard": True,
+            "dashboard_port": args.port,
+            "dashboard_host": args.host,
+        },
+    )
+    print(f"Dashboard at {get_runtime().dashboard.url} (Ctrl-C to stop)")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_start(args) -> int:
     """Join an existing head as a worker node (`ray start --address=...`,
     reference: services.py:1353 start_raylet). Blocks until the head goes
@@ -159,6 +228,18 @@ def main(argv: Optional[list] = None) -> int:
 
     sub.add_parser("metrics", help="prometheus exposition dump")
 
+    p_logs = sub.add_parser("logs", help="tail aggregated worker logs")
+    p_logs.add_argument(
+        "--address", required=True, help="head connect string host:port?token=..."
+    )
+    p_logs.add_argument("--node-id", default=None)
+    p_logs.add_argument("--wid", type=int, default=None)
+    p_logs.add_argument("--follow", "-f", action="store_true")
+
+    p_dash = sub.add_parser("dashboard", help="serve the web dashboard")
+    p_dash.add_argument("--port", type=int, default=8265)
+    p_dash.add_argument("--host", default="127.0.0.1")
+
     p_start = sub.add_parser(
         "start", help="join a head as a worker node (node daemon)"
     )
@@ -181,6 +262,8 @@ def main(argv: Optional[list] = None) -> int:
         "job": cmd_job,
         "metrics": cmd_metrics,
         "start": cmd_start,
+        "logs": cmd_logs,
+        "dashboard": cmd_dashboard,
     }[args.cmd]
     return handler(args)
 
