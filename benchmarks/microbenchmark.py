@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -320,16 +321,15 @@ def main():
             continue
         fn()
     ray_tpu.shutdown()
-    if args.json_out:
-        with open(args.json_out, "w") as f:
-            json.dump(RESULTS, f, indent=2)
     beat = sum(
         1 for r in RESULTS if r["vs_baseline"] is not None and r["vs_baseline"] >= 1.0
     )
     total = sum(1 for r in RESULTS if r["vs_baseline"] is not None)
     # Local memory-bandwidth ceiling for honest GB/s comparisons: the
-    # reference numbers come from an m5.16xlarge-class box; put-gigabytes is
-    # a memcpy at heart and cannot exceed this machine's copy bandwidth.
+    # reference numbers come from an m5.16xlarge-class box (64 vCPUs,
+    # ~20 GB/s single-stream copy); put-gigabytes is a memcpy at heart and
+    # cannot exceed this machine's copy bandwidth, and the multi_client/n_n
+    # scaling rows cannot scale past the local core count.
     a = np.ones(1 << 27, dtype=np.uint8)
     b = np.empty_like(a)
     np.copyto(b, a)
@@ -338,16 +338,21 @@ def main():
         t0 = time.perf_counter()
         np.copyto(b, a)
         best = max(best, a.nbytes / (time.perf_counter() - t0) / 1e9)
-    print(
-        json.dumps(
-            {
-                "benchmark": "summary",
-                "beats_baseline": beat,
-                "compared": total,
-                "local_memcpy_gbps": round(best, 1),
-            }
-        )
-    )
+    summary = {
+        "benchmark": "summary",
+        "beats_baseline": beat,
+        "compared": total,
+        "hardware_cpu_cores": os.cpu_count(),
+        "local_memcpy_gbps": round(best, 1),
+        "baseline_hardware": "m5.16xlarge-class (64 vCPU)",
+    }
+    for row in RESULTS:
+        if row["unit"] == "GB/s":
+            row["pct_of_local_memcpy"] = round(100 * row["value"] / best, 1)
+    print(json.dumps(summary))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(RESULTS + [summary], f, indent=2)
 
 
 if __name__ == "__main__":

@@ -42,7 +42,7 @@ OBJECT_ID_SIZE = TASK_ID_SIZE + 4  # 28
 
 
 class BaseID:
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
     SIZE = 0
 
     def __init__(self, id_bytes: bytes):
@@ -51,6 +51,9 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = id_bytes
+        # IDs key every hot-path dict (store entries, refcounts, task
+        # records); caching the hash shaves ~25 rehashes per task.
+        self._hash = hash((type(self).__name__, id_bytes))
 
     def binary(self) -> bytes:
         return self._bytes
@@ -77,7 +80,7 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        return self._hash
 
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()})"

@@ -203,6 +203,44 @@ class NativeStore:
         if rc not in (0, -1):  # -1 = already present (idempotent reseal)
             raise RuntimeError(f"tps_put failed rc={rc}")
 
+    def create_raw(self, object_id, size: int) -> Optional[memoryview]:
+        """Two-phase put, phase 1 (plasma Create): allocate `size` bytes in
+        shm and return a WRITABLE view of them. The object is invisible to
+        readers until seal_raw. Streaming receivers (object_plane pulls)
+        recv() straight into this view so cross-node transfers never buffer
+        a whole object on the heap. Returns None when the id already holds a
+        live object (idempotent reseal)."""
+        ptr = ctypes.c_void_p()
+        rc = self._lib.tps_create(
+            self._handle, self._key(object_id), size, ctypes.byref(ptr)
+        )
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise NativeStoreFullError(f"native store full creating {object_id}")
+        if rc == -3:
+            raise NativeStoreFullError("native store index full")
+        if rc in (-4, -5):
+            raise NativeStoreFullError("native store unavailable")
+        if rc != 0:
+            raise RuntimeError(f"tps_create failed rc={rc}")
+        array_t = (ctypes.c_uint8 * size).from_address(ptr.value)
+        return memoryview(array_t).cast("B")
+
+    def seal_raw(self, object_id) -> None:
+        """Two-phase put, phase 2 (plasma Seal): publish a create_raw'd
+        object to readers."""
+        rc = self._lib.tps_seal(self._handle, self._key(object_id))
+        if rc != 0:
+            raise RuntimeError(f"tps_seal failed rc={rc}")
+
+    def abort_create(self, object_id) -> None:
+        """Drop a created-but-unsealed allocation (failed stream)."""
+        try:
+            self._lib.tps_delete(self._handle, self._key(object_id))
+        except Exception:
+            pass
+
     def get_raw(self, object_id, track: bool = False) -> Optional[memoryview]:
         """Zero-copy view of the sealed payload (pins the object). With
         track=True the pin is released automatically once every view derived

@@ -297,15 +297,12 @@ class NodeDaemon:
     # -- object plane -------------------------------------------------------
 
     def _serve_bytes(self, oid_bytes: bytes):
+        """Zero-copy provider: the object server streams the live shm view
+        and releases the pin after the last byte."""
         view = self.store.get_raw(oid_bytes)
         if view is None:
             return None
-        try:
-            data = bytes(view)
-        finally:
-            del view
-            self.store.release(oid_bytes)
-        return (TAG_ENVELOPE, data)
+        return (TAG_ENVELOPE, view, lambda: self.store.release(oid_bytes))
 
     def serve_get(self, worker: DaemonWorker, body: dict) -> None:
         """Intercepted get_by_id from a local worker."""
@@ -321,10 +318,15 @@ class NodeDaemon:
         if payload.get("force_value") or self.store is None:
             try:
                 if self.store is not None and self.store.contains(oid):
-                    served = self._serve_bytes(oid)
-                    if served is not None:
+                    view = self.store.get_raw(oid)
+                    if view is not None:
+                        try:
+                            data = bytes(view)  # frame-embedded: must copy
+                        finally:
+                            del view
+                            self.store.release(oid)
                         worker.reply(
-                            msg_id, ok=True, result={"envelope": served[1]}
+                            msg_id, ok=True, result={"envelope": data}
                         )
                         return
             except Exception:
@@ -345,9 +347,13 @@ class NodeDaemon:
         self.to_head("wf", {"wid": worker.wid, "k": "rpc", "b": body})
 
     def _pull_into_store(self, oid: bytes, timeout) -> bool:
-        """Locate via the head, pull from the holding node's object server,
-        seal into the local store. Returns False when no peer holds bytes
-        (head-local small values fall back to the control-plane path)."""
+        """Locate via the head, pull from a holding node's object server
+        (streaming straight into a created shm allocation — pull memory is
+        bounded by the socket buffer, not the object), seal, and advertise
+        the cached copy so later pullers spread across holders instead of
+        hammering the producer (the reference PushManager's broadcast
+        scaling). Returns False when no peer holds bytes (head-local small
+        values fall back to the control-plane path)."""
         with self._lock:
             event = self._pulls.get(oid)
             leader = event is None
@@ -360,19 +366,52 @@ class NodeDaemon:
             reply = self.head_rpc(
                 "locate_object", {"oid": oid, "timeout": timeout}
             )
-            addr = reply.get("addr")
-            if not addr:
-                return False
-            fetched = self.fetcher.fetch((addr[0], addr[1]), oid)
-            if fetched is None:
-                return False
-            tag, data = fetched
-            if tag == TAG_PICKLE:
-                from ray_tpu._private.native_store import envelope_from_pickle
+            addrs = reply.get("addrs") or (
+                [reply["addr"]] if reply.get("addr") else []
+            )
+            for i, addr in enumerate(addrs):
+                created = False
 
-                data = envelope_from_pickle(data)
-            self.store.put_raw(oid, data)
-            return True
+                def create(size: int):
+                    nonlocal created
+                    view = self.store.create_raw(oid, size)
+                    created = view is not None
+                    return view
+
+                try:
+                    fetched = self.fetcher.fetch_into(
+                        (addr[0], addr[1]), oid, create
+                    )
+                except (ConnectionError, OSError):
+                    if created:
+                        self.store.abort_create(oid)
+                    continue  # holder gone/stale: try the next one
+                if fetched is None:
+                    if created:
+                        self.store.abort_create(oid)
+                    continue  # evicted there: try the next holder
+                tag, data = fetched
+                if data is None:
+                    self.store.seal_raw(oid)  # streamed into shm
+                else:
+                    if tag == TAG_PICKLE:
+                        from ray_tpu._private.native_store import (
+                            envelope_from_pickle,
+                        )
+
+                        data = envelope_from_pickle(data)
+                    self.store.put_raw(oid, data)
+                    if not self.store.contains(oid):
+                        # put_raw's idempotent-reseal rc can mask a stale
+                        # kCreated slot: never report success (or advertise
+                        # a copy) unless the object is actually readable.
+                        return False
+                try:
+                    self.to_head("object_cached", {"oid": oid})
+                except Exception:
+                    pass
+                return True
+            return False
         except Exception:
             return False
         finally:

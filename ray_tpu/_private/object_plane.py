@@ -12,7 +12,14 @@ Request:  preamble (head_server.send_preamble, role 'O'), then per fetch:
 Reply:    u8 status (0=ok, 1=missing) + u8 format_tag + u64 size + raw bytes
           format tags: N = native-store envelope (put_raw-able verbatim),
                        P = plain cloudpickle bytes
-Transfers are chunked by the socket; memory is bounded by one object.
+
+Memory is bounded on BOTH ends regardless of object size (the reference's
+chunked ObjectManager push/pull, object_manager.h): the server sendall()s
+straight from the holder's shm view (no heap copy — the provider hands back
+the live view plus a release callback), and the fetcher recv_into()s
+envelope payloads directly into a create_raw'd shm allocation sealed after
+the last byte. Only the small control-plane-pickled values (tag P) buffer
+on the heap.
 """
 
 from __future__ import annotations
@@ -25,10 +32,16 @@ from typing import Callable, Optional
 TAG_ENVELOPE = ord("N")
 TAG_PICKLE = ord("P")
 
+# Per-syscall serve timeout: generous for slow-but-progressing readers
+# (applies to each send/recv, not the whole transfer).
+SERVE_IO_TIMEOUT_S = 60.0
+
 _U32 = struct.Struct("<I")
 _HDR = struct.Struct("<BBQ")  # status, tag, size
 
-# provider(oid_bytes) -> (tag, buffer) or None
+# provider(oid_bytes) -> (tag, buffer[, release_callback]) or None; the
+# server calls release_callback (when present) after the bytes are sent,
+# letting providers serve live shm views without copying them first.
 Provider = Callable[[bytes], Optional[tuple]]
 
 
@@ -104,7 +117,11 @@ class ObjectServer:
                     raise ConnectionError("bad token")
             if _recv_exact(sock, 1) != b"O":  # preamble role byte
                 raise ConnectionError("bad role")
-            sock.settimeout(None)
+            # Bounded per-syscall stall: a hung reader must not hold a shm
+            # pin (zero-copy serves keep the object pinned until sent) or a
+            # server thread forever. Idle cached fetcher connections time
+            # out too — the fetcher transparently reconnects.
+            sock.settimeout(SERVE_IO_TIMEOUT_S)
             while True:
                 raw = _recv_exact(sock, _U32.size)
                 if raw is None:
@@ -119,11 +136,19 @@ class ObjectServer:
                 if found is None:
                     sock.sendall(_HDR.pack(1, 0, 0))
                     continue
-                tag, buf = found
-                view = memoryview(buf)
-                sock.sendall(_HDR.pack(0, tag, view.nbytes))
-                sock.sendall(view)
-                del view
+                tag, buf = found[0], found[1]
+                release = found[2] if len(found) > 2 else None
+                try:
+                    view = memoryview(buf)
+                    sock.sendall(_HDR.pack(0, tag, view.nbytes))
+                    sock.sendall(view)  # kernel-chunked straight from shm
+                    del view
+                finally:
+                    if release is not None:
+                        try:
+                            release()
+                        except Exception:
+                            pass
         except Exception:
             pass
         finally:
@@ -160,6 +185,21 @@ class ObjectFetcher:
     def fetch(self, addr: tuple[str, int], oid_bytes: bytes):
         """Returns (tag, bytes) or None when the peer doesn't hold the
         object. Raises ConnectionError when the peer is unreachable."""
+        return self.fetch_into(addr, oid_bytes, None)
+
+    def fetch_into(
+        self,
+        addr: tuple[str, int],
+        oid_bytes: bytes,
+        create: Optional[Callable[[int], Optional[memoryview]]],
+    ):
+        """Like fetch, but envelope payloads (tag N) stream via recv_into
+        straight into the writable view `create(size)` returns — typically a
+        create_raw'd shm allocation — so pull memory stays bounded by the
+        socket buffer, not the object. Returns (tag, bytes_or_None):
+        bytes is None exactly when the payload landed in the view (the
+        caller seals it). create returning None falls back to heap
+        buffering."""
         addr = (addr[0], int(addr[1]))
         with self._lock:
             sock = self._conns.pop(addr, None)
@@ -167,6 +207,7 @@ class ObjectFetcher:
             if sock is None:
                 sock = self._connect(addr)
                 fresh = True
+            used_view = False
             try:
                 sock.sendall(_U32.pack(len(oid_bytes)) + oid_bytes)
                 hdr = _recv_exact(sock, _HDR.size)
@@ -176,6 +217,18 @@ class ObjectFetcher:
                 if status != 0:
                     self._cache_conn(addr, sock)
                     return None
+                view = None
+                if create is not None and tag == TAG_ENVELOPE:
+                    try:
+                        view = create(size)
+                    except Exception:
+                        view = None  # e.g. store full: buffer on the heap
+                if view is not None:
+                    used_view = True
+                    if not self._recv_into(sock, view, size):
+                        raise ConnectionError("peer closed mid-payload")
+                    self._cache_conn(addr, sock)
+                    return tag, None
                 data = _recv_exact(sock, size)
                 if data is None:
                     raise ConnectionError("peer closed mid-payload")
@@ -187,10 +240,28 @@ class ObjectFetcher:
                 except OSError:
                     pass
                 sock = None
-                if fresh:
+                # Once create() handed out a view the allocation may be
+                # partially written: NEVER retry internally (a second
+                # create() on the same id would fail and silently divert to
+                # a no-op heap put). The caller aborts the allocation and
+                # decides whether to retry.
+                if fresh or used_view:
                     raise
                 # stale cached connection: retry once with a fresh one
         raise ConnectionError(f"unreachable object server {addr}")
+
+    @staticmethod
+    def _recv_into(sock: socket.socket, view: memoryview, size: int) -> bool:
+        got = 0
+        while got < size:
+            try:
+                n = sock.recv_into(view[got:], min(1 << 20, size - got))
+            except OSError:
+                return False
+            if n == 0:
+                return False
+            got += n
+        return True
 
     def _cache_conn(self, addr: tuple[str, int], sock: socket.socket) -> None:
         # One cached connection per peer: the loser of a concurrent fetch

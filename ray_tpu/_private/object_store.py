@@ -70,6 +70,7 @@ class _Entry:
         "spilled_uri",
         "nested_refs",
         "remote_node",
+        "extra_locations",
     )
 
     def __init__(self):
@@ -89,6 +90,10 @@ class _Entry:
         # directory, ownership_based_object_directory.h — the owner records
         # locations, readers pull). None = bytes are local (or not sealed).
         self.remote_node = None
+        # Nodes holding CACHED copies (completed pulls): later pullers
+        # spread across these, making a 1-to-N broadcast scale like the
+        # reference's chunked push tree (object_manager/push_manager.h).
+        self.extra_locations: set | None = None
 
 
 class InProcessStore:
@@ -321,6 +326,40 @@ class InProcessStore:
             if entry is None or not entry.sealed or entry.freed:
                 return None
             return entry.remote_node
+
+    def add_location(self, object_id: ObjectID, node_id) -> None:
+        """Record a node now holding a cached copy of this object."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed:
+                return
+            if entry.extra_locations is None:
+                entry.extra_locations = set()
+            entry.extra_locations.add(node_id)
+
+    def locations_of(self, object_id: ObjectID) -> list:
+        """All nodes known to hold this object's bytes: the producer first,
+        then cached copies."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed:
+                return []
+            out = []
+            if entry.remote_node is not None:
+                out.append(entry.remote_node)
+            if entry.extra_locations:
+                out.extend(
+                    n for n in entry.extra_locations if n != entry.remote_node
+                )
+            return out
+
+    def drop_node_locations(self, node_id) -> None:
+        """Forget every cached copy on a dead node (primary copies are
+        handled by the lost-object path)."""
+        with self._lock:
+            for entry in self._entries.values():
+                if entry.extra_locations:
+                    entry.extra_locations.discard(node_id)
 
     def adopt_fetched(
         self, object_id: ObjectID, value: Any, pickled: bytes | None = None

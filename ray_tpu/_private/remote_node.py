@@ -197,6 +197,12 @@ class NodeHandle:
                 handle._on_disconnect()
                 return
             handle._handle_frame(body["k"], body["b"])
+        elif kind == "object_cached":
+            # This node finished pulling an object into its local store:
+            # record the copy so later pullers spread across holders.
+            self.runtime.store.add_location(
+                ObjectID(body["oid"]), self.node_id
+            )
         elif kind == "wl":
             # Worker log lines tailed by the daemon (log_aggregation.py).
             self.runtime.logs.append(
@@ -248,20 +254,39 @@ class NodeHandle:
         runtime = self.runtime
         if method == "locate_object":
             # Owner-directed location lookup: wait for the seal, then point
-            # the daemon at whichever object server holds the bytes.
+            # the daemon at the object servers holding the bytes. Cached
+            # copies are listed in random order AHEAD of the producer so a
+            # 1-to-N broadcast fans out across nodes that already pulled
+            # instead of serializing on the producer (push_manager.h's
+            # chunked-broadcast scaling, collapsed onto the pull protocol).
+            import random as _random
+
             oid = ObjectID(payload["oid"])
             timeout = payload.get("timeout")
             ready, _ = runtime.store.wait([oid], 1, timeout)
             if not ready:
                 return {"missing": True}
-            location = runtime.store.location_of(oid)
-            if location is not None and location != self.node_id:
-                peer = runtime._node_handles.get(location)
-                if peer is not None and peer.object_addr:
-                    return {"addr": list(peer.object_addr)}
-            if location is None and runtime._object_server is not None:
-                return {"addr": list(runtime._object_server.address)}
-            return {"missing": True}
+            locations = runtime.store.locations_of(oid)
+            primary = runtime.store.location_of(oid)
+            addrs = []
+            cached = []
+            for node_id in locations:
+                if node_id == self.node_id:
+                    continue  # don't point a node at itself
+                peer = runtime._node_handles.get(node_id)
+                if peer is not None and peer.alive and peer.object_addr:
+                    entry = list(peer.object_addr)
+                    if node_id == primary:
+                        addrs.append(entry)
+                    else:
+                        cached.append(entry)
+            _random.shuffle(cached)
+            addrs = cached + addrs
+            if primary is None and runtime._object_server is not None:
+                addrs.append(list(runtime._object_server.address))
+            if not addrs:
+                return {"missing": True}
+            return {"addrs": addrs, "addr": addrs[0]}
         raise ValueError(f"unknown node RPC {method!r}")
 
     # -- death --------------------------------------------------------------

@@ -384,12 +384,10 @@ class Runtime:
         if ns is not None:
             view = ns.get_raw(oid)
             if view is not None:
-                try:
-                    data = bytes(view)
-                finally:
-                    del view
-                    ns.release(oid)
-                return (TAG_ENVELOPE, data)
+                # Serve straight from shm: the object server sendall()s the
+                # live view and releases the pin afterwards — no heap copy,
+                # memory bounded regardless of object size.
+                return (TAG_ENVELOPE, view, lambda: ns.release(oid))
         data = self.store.get_serialized(oid)
         if data is not None:
             return (TAG_PICKLE, data)
@@ -407,20 +405,33 @@ class Runtime:
         from ray_tpu._private import native_store as native_mod
         from ray_tpu._private.object_plane import TAG_ENVELOPE
 
-        handle = self._node_handles.get(node_id)
-        if handle is None or not handle.alive or not handle.object_addr:
-            raise ObjectLostError(
-                oid, f"Object {oid} lived on node {node_id}, which is gone"
-            )
-        try:
-            fetched = self._object_fetcher.fetch(handle.object_addr, oid.binary())
-        except (ConnectionError, OSError) as exc:
-            raise ObjectLostError(
-                oid, f"Pull of {oid} from node {node_id} failed: {exc}"
-            ) from None
+        # Try every known holder (producer first, then cached copies): a
+        # dead producer doesn't lose the object while any node still holds
+        # a pulled copy.
+        candidates = [node_id] + [
+            n for n in self.store.locations_of(oid) if n != node_id
+        ]
+        fetched = None
+        last_exc: Exception | None = None
+        for candidate in candidates:
+            handle = self._node_handles.get(candidate)
+            if handle is None or not handle.alive or not handle.object_addr:
+                continue
+            try:
+                fetched = self._object_fetcher.fetch(
+                    handle.object_addr, oid.binary()
+                )
+            except (ConnectionError, OSError) as exc:
+                last_exc = exc
+                continue
+            if fetched is not None:
+                break
         if fetched is None:
             raise ObjectLostError(
-                oid, f"Object {oid} was evicted from node {node_id}"
+                oid,
+                f"Object {oid} could not be pulled from any holder "
+                f"{[str(c) for c in candidates]}"
+                + (f" (last error: {last_exc})" if last_exc else ""),
             )
         tag, data = fetched
         if tag == TAG_ENVELOPE:
@@ -489,6 +500,8 @@ class Runtime:
             # raises ObjectLostError (dead node), which is what triggers
             # lineage recovery. Unsealing here would block readers forever.
             node_handle.alive = False
+            # Cached copies on the dead node must stop being advertised.
+            self.store.drop_node_locations(node_id)
         if engine is None:
             return
         # Collect this node's actors before shutdown kills them.

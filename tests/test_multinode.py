@@ -287,3 +287,62 @@ def test_actor_restart_after_node_death(cluster):
     # max_restarts=1: the actor comes back on a surviving node.
     second = ray_tpu.get(s.where.remote())
     assert second != first
+
+
+def test_streaming_pull_large_object(cluster):
+    """A multi-MB object crosses nodes through the streaming path: the
+    producer's shm view is sent without a heap copy and the puller recv()s
+    straight into a created shm allocation (bounded memory on both ends)."""
+    runtime, daemons = cluster
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def produce():
+        return np.arange(8 << 20, dtype=np.uint8)  # 8 MB, well over threshold
+
+    @ray_tpu.remote(resources={"nodeB": 0.1})
+    def check(arr):
+        return int(arr[0]), int(arr[123456]), int(arr[-1]), arr.nbytes
+
+    ref = produce.remote()
+    first, mid, last, nbytes = ray_tpu.get(check.remote(ref))
+    expect = np.arange(8 << 20, dtype=np.uint8)
+    assert (first, mid, last) == (int(expect[0]), int(expect[123456]), int(expect[-1]))
+    assert nbytes == 8 << 20
+
+
+def test_cached_copy_survives_producer_death(cluster):
+    """After nodeB pulls an object produced on nodeA, the head learns of the
+    cached copy (object_cached); killing nodeA must NOT force lineage
+    re-execution — the driver's get is served from nodeB's cache."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    runtime, daemons = cluster
+    node_a = _node_id_with_resource(runtime, "nodeA")
+    executions = []
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        return np.full(2 << 20, 3, dtype=np.uint8)  # 2 MB
+
+    @ray_tpu.remote(resources={"nodeB": 0.1})
+    def reader(arr):
+        return int(arr[0])
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_a.hex(), soft=True
+        )
+    ).remote()
+    assert ray_tpu.get(reader.remote(ref)) == 3  # nodeB now holds a copy
+    node_b = _node_id_with_resource(runtime, "nodeB")
+    _wait_for(
+        lambda: node_b in runtime.store.locations_of(ref.id),
+        msg="cached location recorded on the head",
+    )
+    daemons[0].kill()
+    _wait_for(
+        lambda: len(runtime.controller.alive_nodes()) == 2,
+        msg="node death detected",
+    )
+    arr = ray_tpu.get(ref)  # served from nodeB's cached copy, no recovery
+    assert int(arr[0]) == 3 and arr.nbytes == 2 << 20

@@ -205,3 +205,39 @@ os._exit(1)   # die holding the robust mutex
     assert bytes(view) == b"data-after"
     store.release(b"after")
     assert store._lib.tps_poisoned(store._handle) == 0
+
+
+def test_create_seal_streaming_put(store):
+    """Two-phase put (plasma Create/Seal): write into the returned view
+    incrementally, invisible to readers until sealed."""
+    payload = bytes(range(256)) * 64
+    view = store.create_raw(b"stream-oid", len(payload))
+    assert view is not None
+    assert not store.contains(b"stream-oid")  # kCreated: invisible
+    half = len(payload) // 2
+    view[:half] = payload[:half]
+    view[half:] = payload[half:]
+    del view
+    store.seal_raw(b"stream-oid")
+    assert store.contains(b"stream-oid")
+    got = store.get_raw(b"stream-oid")
+    assert bytes(got) == payload
+    del got
+    store.release(b"stream-oid")
+    # create on a live object -> None (idempotent reseal signal)
+    assert store.create_raw(b"stream-oid", 10) is None
+
+
+def test_abort_create_reclaims(store):
+    view = store.create_raw(b"aborted-oid", 4096)
+    assert view is not None
+    del view
+    store.abort_create(b"aborted-oid")
+    assert not store.contains(b"aborted-oid")
+    # the id is reusable after an abort
+    view = store.create_raw(b"aborted-oid", 16)
+    assert view is not None
+    view[:16] = b"x" * 16
+    del view
+    store.seal_raw(b"aborted-oid")
+    assert store.contains(b"aborted-oid")
