@@ -1,0 +1,34 @@
+"""IMPALA throughput sweep at bench shapes (MinAtar-Breakout)."""
+import os
+import sys
+import time
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+runners, envs, frag, bs = (int(x) for x in sys.argv[1:5])
+ray_tpu.init(num_cpus=max(8, os.cpu_count() or 1), ignore_reinit_error=True)
+config = (
+    IMPALAConfig()
+    .environment("MinAtar-Breakout")
+    .env_runners(
+        num_env_runners=runners,
+        num_envs_per_env_runner=envs,
+        rollout_fragment_length=frag,
+    )
+    .training(train_batch_size=bs)
+)
+algo = config.build()
+algo.train()
+steps0 = algo._env_steps_total
+t0 = time.perf_counter()
+for _ in range(6):
+    algo.train()
+dt = time.perf_counter() - t0
+print(
+    f"runners={runners} envs={envs} frag={frag} bs={bs}: "
+    f"{(algo._env_steps_total - steps0)/dt:,.0f} env_steps/s",
+    flush=True,
+)
+algo.cleanup()
+ray_tpu.shutdown()

@@ -93,6 +93,44 @@ class ReplicaActor:
                 self._num_ongoing -= 1
                 self._num_processed += 1
 
+    def handle_request_streaming(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        multiplexed_model_id: str = "",
+    ):
+        """Generator variant: the user callable returns a (sync) generator
+        and each yielded item is sealed as its own object for the caller's
+        ObjectRefGenerator (reference: replica.py handle_request_streaming
+        → StreamingObjectRefGenerator)."""
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        with self._lock:
+            self._num_ongoing += 1
+        token = _set_multiplexed_model_id(multiplexed_model_id)
+        try:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            if not hasattr(result, "__iter__") or isinstance(
+                result, (str, bytes, dict)
+            ):
+                yield result  # non-iterable: a one-item stream
+                return
+            yield from result
+        finally:
+            from ray_tpu.serve.multiplex import _multiplexed_model_id
+
+            _multiplexed_model_id.reset(token)
+            with self._lock:
+                self._num_ongoing -= 1
+                self._num_processed += 1
+
     def get_metrics(self) -> dict:
         with self._lock:
             return {

@@ -33,8 +33,50 @@ class DeploymentResponse:
         # when the reply lands — nothing to do here beyond the get.
         return ray.get(self._ref, timeout=timeout_s)
 
+    def __await__(self):
+        # Async ingress path: `await handle.remote(...)` resolves without
+        # blocking a thread (the underlying ObjectRef registers a seal
+        # callback on the running loop).
+        return self._ref.__await__()
+
     def _to_object_ref(self) -> ObjectRef:
         return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterates the replica generator's items (sync or
+    async), one object per yield (reference: serve handle's
+    DeploymentResponseGenerator over StreamingObjectRefGenerator)."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        from ray_tpu import api as ray
+
+        for ref in self._gen:
+            yield ray.get(ref)
+
+    def __aiter__(self):
+        return self._agen()
+
+    async def _agen(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        while True:
+            # The blocking item-wait runs in the default executor; the
+            # payload itself resolves async via the ref's seal callback.
+            ref = await loop.run_in_executor(None, self._next_or_none)
+            if ref is None:
+                return
+            yield await ref
+
+    def _next_or_none(self):
+        try:
+            return next(self._gen)
+        except StopIteration:
+            return None
 
 
 class Router:
@@ -125,7 +167,8 @@ class Router:
         args: tuple,
         kwargs: dict,
         multiplexed_model_id: str = "",
-    ) -> DeploymentResponse:
+        stream: bool = False,
+    ):
         with self._lock:
             self._queued += 1
             prefer = (
@@ -147,6 +190,20 @@ class Router:
                 self._model_affinity.move_to_end(multiplexed_model_id)
                 while len(self._model_affinity) > 256:
                     self._model_affinity.popitem(last=False)
+        if stream:
+            gen = handle.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method_name, args, kwargs, multiplexed_model_id)
+
+            # In-flight settles when the generator COMPLETES (the completion
+            # ref seals after the last yield).
+            def _on_stream_done(_ref=gen._completion_ref, _tag=tag):
+                self._on_done(_tag)
+
+            get_runtime().store.on_sealed(
+                gen._completion_ref.id, _on_stream_done
+            )
+            return DeploymentResponseGenerator(gen)
         ref = handle.handle_request.remote(
             method_name, args, kwargs, multiplexed_model_id
         )
@@ -217,6 +274,7 @@ class DeploymentHandle:
         max_concurrent_queries: int = 100,
         method_name: str = "__call__",
         multiplexed_model_id: str = "",
+        stream: bool = False,
         _router: Optional[Router] = None,
     ):
         self._app = app
@@ -224,6 +282,7 @@ class DeploymentHandle:
         self._max_q = max_concurrent_queries
         self._method_name = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router = _router
 
     def _get_router(self) -> Router:
@@ -231,15 +290,17 @@ class DeploymentHandle:
             self._router = Router(self._app, self._deployment, self._max_q)
         return self._router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._get_router().assign(
-            self._method_name, args, kwargs, self._model_id
+            self._method_name, args, kwargs, self._model_id,
+            stream=self._stream,
         )
 
     def options(
         self,
         method_name: Optional[str] = None,
         multiplexed_model_id: Optional[str] = None,
+        stream: Optional[bool] = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._app,
@@ -249,6 +310,7 @@ class DeploymentHandle:
             multiplexed_model_id
             if multiplexed_model_id is not None
             else self._model_id,
+            stream if stream is not None else self._stream,
             _router=self._router,
         )
         return h
@@ -268,6 +330,7 @@ class DeploymentHandle:
                 self._max_q,
                 self._method_name,
                 self._model_id,
+                self._stream,
             ),
         )
 

@@ -10,6 +10,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -30,6 +31,7 @@ from ray_tpu.tune.search.searcher import (
     RandomSearch,
     Searcher,
 )
+from ray_tpu.tune.search.tpe import TPESearch
 from ray_tpu.tune.trainable import Trainable, with_parameters, wrap_function
 from ray_tpu.tune.tuner import TuneConfig, Tuner, run
 
@@ -46,6 +48,8 @@ __all__ = [
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "RandomSearch",
+    "TPESearch",
+    "PB2",
     "ResultGrid",
     "Searcher",
     "Trainable",

@@ -1,3 +1,4 @@
 """Search algorithms."""
 from ray_tpu.tune.search.sample import *  # noqa
 from ray_tpu.tune.search.searcher import BasicVariantGenerator, ConcurrencyLimiter, RandomSearch, Searcher  # noqa
+from ray_tpu.tune.search.tpe import TPESearch  # noqa

@@ -501,3 +501,125 @@ def test_replica_health_check_replaces_unhealthy(serve_instance):
                 pass  # raced the replacement
         time.sleep(0.2)
     assert handle.check_health.remote().result(timeout_s=10) is True
+
+
+def test_http_proxy_streaming(serve_instance):
+    """?stream=1 returns a chunked ndjson response, one line per item the
+    generator ingress yields (the ASGI-streaming analog)."""
+    from ray_tpu.serve._private.http_proxy import start_proxy, stop_proxy
+
+    @serve.deployment
+    def counter(n):
+        def gen():
+            for i in range(int(n)):
+                yield {"i": i, "sq": i * i}
+        return gen()
+
+    serve.run(counter.bind(), name="streamer")
+    host, port = start_proxy()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/streamer?stream=1",
+            data=json.dumps(5).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers.get("Content-Type") == "application/x-ndjson"
+            lines = [
+                json.loads(line) for line in resp.read().splitlines() if line
+            ]
+        assert [row["result"]["i"] for row in lines] == list(range(5))
+        assert lines[3]["result"]["sq"] == 9
+    finally:
+        stop_proxy()
+
+
+def test_http_proxy_concurrent_inflight(serve_instance):
+    """The asyncio proxy keeps many slow requests in flight at once — wall
+    time for N concurrent slow calls ~= one call, not N (no
+    thread-per-request serialization; replicas run them in parallel)."""
+    import threading as _threading
+    import time as _time
+
+    from ray_tpu.serve._private.http_proxy import start_proxy, stop_proxy
+
+    @serve.deployment(max_concurrent_queries=16)
+    class Slow:
+        def __call__(self, x):
+            _time.sleep(1.0)
+            return x
+
+    serve.run(Slow.options(num_replicas=1).bind(), name="slowapp")
+    host, port = start_proxy()
+    results = []
+    errors = []
+
+    def one(i):
+        try:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/slowapp",
+                data=json.dumps(i).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                results.append(json.loads(resp.read())["result"])
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    try:
+        t0 = _time.monotonic()
+        threads = [_threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        wall = _time.monotonic() - t0
+        assert not errors, errors
+        assert sorted(results) == list(range(8))
+        # 8 sequential 1s calls would take >= 8s; concurrent ~= 1-3s.
+        assert wall < 6.0, f"requests serialized: {wall:.1f}s for 8 calls"
+    finally:
+        stop_proxy()
+
+
+def test_http_proxy_request_timeout(serve_instance):
+    """Per-request X-Serve-Timeout-S produces a 504 instead of hanging."""
+    import time as _time
+
+    from ray_tpu.serve._private.http_proxy import start_proxy, stop_proxy
+
+    @serve.deployment
+    def sleepy(x):
+        _time.sleep(5.0)
+        return x
+
+    serve.run(sleepy.bind(), name="sleepyapp")
+    host, port = start_proxy()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/sleepyapp",
+            data=json.dumps(1).encode(),
+            headers={"X-Serve-Timeout-S": "1.0"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                raise AssertionError(f"expected 504, got {resp.status}")
+        except urllib.error.HTTPError as err:
+            assert err.code == 504
+            assert "timed out" in json.loads(err.read())["error"]
+    finally:
+        stop_proxy()
+
+
+def test_streaming_handle_direct(serve_instance):
+    """handle.options(stream=True).remote() yields items as they are
+    produced (sync iteration path)."""
+    @serve.deployment
+    def gen_app(n):
+        def gen():
+            for i in range(int(n)):
+                yield i * 10
+        return gen()
+
+    handle = serve.run(gen_app.bind(), name="genapp")
+    items = list(handle.options(stream=True).remote(4))
+    assert items == [0, 10, 20, 30]
