@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig, APPOLearner
+
+__all__ = ["APPO", "APPOConfig", "APPOLearner"]

@@ -42,14 +42,19 @@ class DQNModule(RLModule):
                 hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
             )
         super().__init__(observation_space, action_space, model_config, net, seed)
-        self.epsilon_initial = float(model_config.get("epsilon_initial", 1.0))
-        self.epsilon_final = float(model_config.get("epsilon_final", 0.05))
-        self.epsilon_timesteps = int(model_config.get("epsilon_timesteps", 10_000))
+        from ray_tpu.rllib.utils.exploration import EpsilonGreedy
+
+        self.exploration = EpsilonGreedy(
+            epsilon_initial=float(model_config.get("epsilon_initial", 1.0)),
+            epsilon_final=float(model_config.get("epsilon_final", 0.05)),
+            epsilon_timesteps=int(
+                model_config.get("epsilon_timesteps", 10_000)
+            ),
+            schedule=model_config.get("epsilon_schedule", "linear"),
+        )
 
     def exploration_inputs(self, timestep: int) -> dict:
-        frac = min(1.0, timestep / max(1, self.epsilon_timesteps))
-        eps = self.epsilon_initial + frac * (self.epsilon_final - self.epsilon_initial)
-        return {"epsilon": np.float32(eps)}
+        return self.exploration.inputs(timestep)
 
     def forward_train(self, params, batch) -> dict:
         return {"q_values": self.apply(params, batch[SampleBatch.OBS])}

@@ -36,8 +36,11 @@ async def _read_request(reader: asyncio.StreamReader):
     body) or None on EOF between requests (keep-alive close)."""
     try:
         request_line = await reader.readline()
-    except (ConnectionError, asyncio.LimitOverrunError):
+    except ConnectionError:
         return None
+    except (ValueError, asyncio.LimitOverrunError):
+        # StreamReader wraps over-limit lines in ValueError.
+        raise _BadRequest("request line too long") from None
     if not request_line:
         return None
     parts = request_line.decode("latin-1").rstrip("\r\n").split(" ")
@@ -47,7 +50,10 @@ async def _read_request(reader: asyncio.StreamReader):
     headers: dict[str, str] = {}
     total = 0
     while True:
-        line = await reader.readline()
+        try:
+            line = await reader.readline()
+        except (ValueError, asyncio.LimitOverrunError):
+            raise _BadRequest("header line too long") from None
         total += len(line)
         if total > _MAX_HEADER_BYTES:
             raise _BadRequest("headers too large")
@@ -187,13 +193,19 @@ class HTTPProxyActor:
         try:
             # Submission runs in the executor: replica selection can briefly
             # block when every replica is at max_concurrent_queries, and the
-            # event loop must keep serving other requests meanwhile. The
-            # WAIT for the reply is fully async (seal-callback driven).
+            # event loop must keep serving other requests meanwhile. Both
+            # the submission AND the reply wait are bounded by the request
+            # deadline; the WAIT itself is fully async (seal-callback
+            # driven).
             loop = asyncio.get_event_loop()
-            response = await loop.run_in_executor(
-                None, lambda: handle.remote(payload)
+            deadline = loop.time() + timeout_s
+            response = await asyncio.wait_for(
+                loop.run_in_executor(None, lambda: handle.remote(payload)),
+                timeout=timeout_s,
             )
-            result = await asyncio.wait_for(response, timeout=timeout_s)
+            result = await asyncio.wait_for(
+                response, timeout=max(0.0, deadline - loop.time())
+            )
             writer.write(_json_response(200, {"result": result}))
         except asyncio.TimeoutError:
             writer.write(
@@ -224,8 +236,11 @@ class HTTPProxyActor:
             # every item wait is deadline-bounded so a stalled generator
             # still honors X-Serve-Timeout-S.
             stream_handle = handle.options(stream=True)
-            gen = await loop.run_in_executor(
-                None, lambda: stream_handle.remote(payload)
+            gen = await asyncio.wait_for(
+                loop.run_in_executor(
+                    None, lambda: stream_handle.remote(payload)
+                ),
+                timeout=max(0.0, deadline - loop.time()),
             )
             aiter = gen.__aiter__()
             while True:

@@ -117,6 +117,19 @@ class ReplicaActor:
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
+            if inspect.isasyncgen(result):
+                # Async-generator deployments: drain on a private loop so
+                # each yielded value becomes a stream item.
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(result.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    loop.close()
+                return
             if not hasattr(result, "__iter__") or isinstance(
                 result, (str, bytes, dict)
             ):

@@ -43,6 +43,9 @@ class DeploymentResponse:
         return self._ref
 
 
+_PENDING = object()  # executor-poll slice expired with no item yet
+
+
 class DeploymentResponseGenerator:
     """Streaming response: iterates the replica generator's items (sync or
     async), one object per yield (reference: serve handle's
@@ -65,18 +68,25 @@ class DeploymentResponseGenerator:
 
         loop = asyncio.get_event_loop()
         while True:
-            # The blocking item-wait runs in the default executor; the
-            # payload itself resolves async via the ref's seal callback.
-            ref = await loop.run_in_executor(None, self._next_or_none)
+            # Short-sliced executor polls: a stalled stream never parks a
+            # shared executor thread for long (0.2s max), so concurrent
+            # streams timeshare the pool and a cancelled consumer leaks at
+            # most one slice of thread time.
+            ref = await loop.run_in_executor(None, self._poll_next)
             if ref is None:
                 return
+            if ref is _PENDING:
+                continue
             yield await ref
 
-    def _next_or_none(self):
+    def _poll_next(self):
+        from ray_tpu._private.streaming import _SENTINEL
+
         try:
-            return next(self._gen)
-        except StopIteration:
-            return None
+            ref = self._gen._stream.next(timeout=0.2)
+        except TimeoutError:
+            return _PENDING
+        return None if ref is _SENTINEL else ref
 
 
 class Router:

@@ -34,12 +34,21 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
-        self.worker_group = WorkerGroup(
-            num_workers=self._scaling.num_workers,
-            bundle_specs=self._scaling.bundle_specs(),
-            strategy=self._scaling.strategy(),
-        )
-        self._backend.on_start(self.worker_group, self._backend_config)
+        try:
+            self.worker_group = WorkerGroup(
+                num_workers=self._scaling.num_workers,
+                bundle_specs=self._scaling.bundle_specs(),
+                strategy=self._scaling.strategy(),
+            )
+            self._backend.on_start(self.worker_group, self._backend_config)
+        except Exception as exc:
+            # A worker dying while the group forms (e.g. its node was killed
+            # between scheduling and startup) is a recoverable group failure:
+            # the trainer's FailureConfig loop re-forms on surviving nodes.
+            # Tear down whatever partially formed so the retry doesn't leak
+            # actors (and the resources they hold).
+            self.shutdown()
+            raise TrainingWorkerError(f"worker group failed to start: {exc}") from exc
 
     def start_training(
         self,
@@ -60,7 +69,10 @@ class BackendExecutor:
             refs.append(
                 worker.start_training.remote(train_fn, config, checkpoint, shards)
             )
-        ray_tpu.get(refs, timeout=300.0)
+        try:
+            ray_tpu.get(refs, timeout=300.0)
+        except Exception as exc:
+            raise TrainingWorkerError(f"training failed to launch: {exc}") from exc
 
     def next_results(self) -> Optional[list[dict]]:
         """One rendezvous round: every worker's next report, or None when all

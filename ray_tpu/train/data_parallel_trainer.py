@@ -126,10 +126,15 @@ class DataParallelTrainer:
         failures = 0
         start = time.monotonic()
 
-        executor.start()
         try:
             while True:
                 try:
+                    # Whole-group (re-)form — restart() is shutdown+start,
+                    # idempotent when nothing is up yet (TPU mesh restarts
+                    # are all-or-nothing). Inside the try so a death DURING
+                    # the re-form (e.g. placement raced node-failure
+                    # detection) counts as another recoverable failure.
+                    executor.restart()
                     # Fresh split coordinators per attempt: after a worker
                     # failure the old iterators are mid-stream/exhausted.
                     self._split_cache = {}
@@ -140,10 +145,8 @@ class DataParallelTrainer:
                     if max_failures != -1 and failures > max_failures:
                         error = exc
                         break
-                    # Whole-group restart from the latest checkpoint
-                    # (TPU mesh restarts are all-or-nothing).
+                    # Resume the next attempt from the latest checkpoint.
                     self._resume_checkpoint = ckpt_manager.latest or self._resume_checkpoint
-                    executor.restart()
         finally:
             executor.shutdown()
 

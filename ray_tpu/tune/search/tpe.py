@@ -42,13 +42,17 @@ _CONTINUOUS = (Uniform, LogUniform, QUniform, Normal, QNormal)
 
 class _ContinuousDim:
     """Gaussian-KDE model of one continuous dimension (log-transformed for
-    LogUniform domains)."""
+    LogUniform domains; large Randint ranges model continuously with
+    integer rounding — enumerating them would blow up memory)."""
 
     def __init__(self, domain: Domain):
         self.domain = domain
         self.log = isinstance(domain, LogUniform)
+        self.integer = isinstance(domain, Randint)
         lo = getattr(domain, "lower", None)
         hi = getattr(domain, "upper", None)
+        if self.integer and hi is not None:
+            hi = hi - 1  # Randint upper bound is exclusive
         self.lo = self._tf(lo) if lo is not None else None
         self.hi = self._tf(hi) if hi is not None else None
 
@@ -96,6 +100,8 @@ class _ContinuousDim:
         q = getattr(self.domain, "q", None)
         if q:
             value = round(value / q) * q
+        if self.integer:
+            value = int(round(value))
         return value
 
     def log_density(self, value: float, obs: List[float]) -> float:
@@ -175,7 +181,14 @@ class TPESearch(Searcher):
         for key, domain in space.items():
             if isinstance(domain, _CONTINUOUS):
                 self._dims[key] = _ContinuousDim(domain)
-            elif isinstance(domain, (Choice, Randint)):
+            elif isinstance(domain, Randint):
+                # Small integer ranges are categorical counts; large ones
+                # would enumerate billions of values — model continuously.
+                if domain.upper - domain.lower <= 64:
+                    self._dims[key] = _CategoricalDim(domain)
+                else:
+                    self._dims[key] = _ContinuousDim(domain)
+            elif isinstance(domain, Choice):
                 self._dims[key] = _CategoricalDim(domain)
         # trial_id -> config for pending trials; (config, score) history.
         self._pending: Dict[str, dict] = {}
@@ -231,20 +244,10 @@ class TPESearch(Searcher):
             )
             score = 0.0
             for key, dim in self._dims.items():
-                value = dim.sample(obs_good[key], self._rng) if isinstance(
-                    dim, _ContinuousDim
-                ) else dim.sample(
-                    [c[key] for c in good if key in c], self._rng
-                )
+                value = dim.sample(obs_good[key], self._rng)
                 candidate[key] = value
-                if isinstance(dim, _ContinuousDim):
-                    score += dim.log_density(value, obs_good[key])
-                    score -= dim.log_density(value, obs_bad[key])
-                else:
-                    g = [c[key] for c in good if key in c]
-                    b = [c[key] for c in bad if key in c]
-                    score += dim.log_density(value, g)
-                    score -= dim.log_density(value, b)
+                score += dim.log_density(value, obs_good[key])
+                score -= dim.log_density(value, obs_bad[key])
             if score > best_score:
                 best_config, best_score = candidate, score
         return best_config
