@@ -4,6 +4,8 @@ from ray_tpu.autoscaler.monitor import Monitor
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     NodeProvider,
+    SSHNodeProvider,
+    SubprocessNodeProvider,
     TAG_NODE_TYPE,
     TAG_SLICE_ID,
 )
@@ -15,6 +17,8 @@ __all__ = [
     "LoadSnapshot",
     "Monitor",
     "NodeProvider",
+    "SSHNodeProvider",
+    "SubprocessNodeProvider",
     "ResourceDemandScheduler",
     "StandardAutoscaler",
     "TAG_NODE_TYPE",

@@ -91,3 +91,212 @@ class FakeNodeProvider(NodeProvider):
             info = self._nodes.pop(node_id, None)
         if info is not None:
             self.runtime.remove_node(info["node_id"])
+
+
+PROVIDER_LABEL = "autoscaler-provider-id"
+
+
+class _DaemonBackedProvider(NodeProvider):
+    """Shared half of the providers whose "nodes" are REAL node daemons
+    that self-register with the head over TCP (`ray-tpu start --address`).
+
+    The provider tags each launch with a unique label; the runtime NodeID
+    mapping (needed by the autoscaler's idle-termination and pending-join
+    accounting) is resolved by scanning the controller's node labels."""
+
+    def __init__(self, runtime, provider_config: Optional[dict] = None):
+        super().__init__(provider_config)
+        self.runtime = runtime
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}  # pid -> {tags, ...}
+
+    def _head_address(self) -> str:
+        addr = self.provider_config.get("address")
+        if addr:
+            return addr
+        head = getattr(self.runtime, "_head_server", None)
+        if head is None:
+            raise RuntimeError(
+                "provider needs the head's TCP address: call "
+                "runtime.serve_clients() first or set provider_config['address']"
+            )
+        return head.address
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            dead = [
+                pid for pid, info in self._nodes.items() if self._is_dead(info)
+            ]
+            for pid in dead:
+                self._nodes.pop(pid, None)
+            return list(self._nodes)
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def runtime_node_id(self, provider_id: str):
+        for node in self.runtime.controller.nodes.values():
+            if node.labels.get(PROVIDER_LABEL) == provider_id:
+                return node.node_id
+        return None
+
+    def create_node(self, node_type: str, type_config: dict, count: int = 1) -> List[str]:
+        created: List[str] = []
+        address = self._head_address()
+        resources = dict(type_config.get("resources", {}))
+        labels = dict(type_config.get("labels", {}))
+        hosts = int(type_config.get("hosts_per_slice", 1))
+        for _ in range(count):
+            slice_id = uuid.uuid4().hex[:8] if hosts > 1 else None
+            for host in range(hosts):
+                pid = f"{self.KIND}-{uuid.uuid4().hex[:12]}"
+                tags = {TAG_NODE_TYPE: node_type}
+                node_labels = dict(labels)
+                node_labels[PROVIDER_LABEL] = pid
+                if slice_id:
+                    tags[TAG_SLICE_ID] = slice_id
+                    tags[TAG_SLICE_HOST] = str(host)
+                    node_labels["tpu-slice"] = slice_id
+                    node_labels["tpu-host"] = str(host)
+                info = self._launch(address, resources, node_labels, type_config)
+                info["tags"] = tags
+                with self._lock:
+                    self._nodes[pid] = info
+                created.append(pid)
+        return created
+
+    # subclass surface -----------------------------------------------------
+
+    KIND = "daemon"
+
+    def _launch(self, address: str, resources: dict, labels: dict,
+                type_config: dict) -> dict:
+        raise NotImplementedError
+
+    def _is_dead(self, info: dict) -> bool:
+        raise NotImplementedError
+
+
+class SubprocessNodeProvider(_DaemonBackedProvider):
+    """Provisions "hosts" as local node-daemon subprocesses — the
+    integration-testable stand-in for a cloud API (the reference's
+    fake_multi_node provider pattern, node_provider.py:237, except these
+    are REAL daemons over real TCP: the full demand → provision →
+    `ray-tpu start` → join → schedule loop runs end to end)."""
+
+    KIND = "subproc"
+
+    def _launch(self, address: str, resources: dict, labels: dict,
+                type_config: dict) -> dict:
+        import json
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.node_daemon",
+                "--address", address,
+                "--resources", json.dumps(resources),
+                "--labels", json.dumps(labels),
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        return {"proc": proc}
+
+    def _is_dead(self, info: dict) -> bool:
+        return info["proc"].poll() is not None
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        proc = info["proc"]
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+
+
+class SSHNodeProvider(_DaemonBackedProvider):
+    """Provisions daemons on a static host pool over SSH — the on-prem /
+    reserved-TPU-pod shape (reference: the cluster-YAML `provider` +
+    `ray start` bootstrap in autoscaler/_private/command_runner.py).
+
+    provider_config:
+      worker_ips: ["10.0.0.2", ...]   hosts available for provisioning
+      ssh_user:   "ubuntu"            (optional)
+      ssh_key:    "~/.ssh/key.pem"    (optional)
+      python:     "python3"           remote interpreter (optional)
+
+    Each create_node leases the next free IP and starts the daemon there;
+    terminate kills it remotely and returns the IP to the pool."""
+
+    KIND = "ssh"
+
+    def __init__(self, runtime, provider_config: Optional[dict] = None):
+        super().__init__(runtime, provider_config)
+        self._free_ips: list = list(self.provider_config.get("worker_ips", []))
+
+    def _ssh_base(self, ip: str) -> list:
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+        key = self.provider_config.get("ssh_key")
+        if key:
+            cmd += ["-i", key]
+        user = self.provider_config.get("ssh_user")
+        cmd.append(f"{user}@{ip}" if user else ip)
+        return cmd
+
+    def _launch(self, address: str, resources: dict, labels: dict,
+                type_config: dict) -> dict:
+        import json
+        import shlex
+        import subprocess
+
+        with self._lock:
+            if not self._free_ips:
+                raise RuntimeError("SSH provider host pool exhausted")
+            ip = self._free_ips.pop(0)
+        python = self.provider_config.get("python", "python3")
+        remote = (
+            f"nohup {python} -m ray_tpu._private.node_daemon "
+            f"--address {shlex.quote(address)} "
+            f"--resources {shlex.quote(json.dumps(resources))} "
+            f"--labels {shlex.quote(json.dumps(labels))} "
+            f">/tmp/ray-tpu-daemon.log 2>&1 & echo $!"
+        )
+        out = subprocess.run(
+            self._ssh_base(ip) + [remote],
+            capture_output=True, text=True, timeout=60, check=True,
+        )
+        return {"ip": ip, "remote_pid": out.stdout.strip()}
+
+    def _is_dead(self, info: dict) -> bool:
+        # Liveness is authoritative from the runtime (the daemon
+        # fate-shares with its TCP connection); avoid an ssh per poll.
+        return False
+
+    def terminate_node(self, node_id: str) -> None:
+        import subprocess
+
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        try:
+            subprocess.run(
+                self._ssh_base(info["ip"])
+                + [f"kill {info['remote_pid']} 2>/dev/null || true"],
+                capture_output=True, timeout=60,
+            )
+        except Exception:
+            # Best-effort: the daemon fate-shares with its head connection,
+            # so an unreachable host's daemon dies when the head drops it.
+            pass
+        finally:
+            with self._lock:
+                self._free_ips.append(info["ip"])
