@@ -166,6 +166,8 @@ class NodeEngine:
         resolve_args: Callable[[TaskSpec], tuple[tuple, dict]],
     ) -> None:
         def run():
+            from ray_tpu.util import tracing
+
             CONTEXT.task_id = spec.task_id
             CONTEXT.job_id = spec.job_id
             CONTEXT.node_id = self.node.node_id
@@ -173,24 +175,30 @@ class NodeEngine:
             CONTEXT.task_name = spec.name
             CONTEXT.resource_grant = grant
             CONTEXT.put_counter = 0
+            # Re-enter the submitter's trace so user spans and nested
+            # submits nest under this task (tracing_helper's execution half).
+            _trace_token = tracing.activate_task(spec)
             try:
-                args, kwargs = resolve_args(spec)
-                # Env staging can fail (missing working_dir): must surface as
-                # the task's failure, never escape into the pool and hang the
-                # caller with the grant leaked.
-                env_cm = _activate_runtime_env(spec)
-            except BaseException as exc:  # dep was freed/lost, bad env
-                self._on_task_done(
-                    spec,
-                    self.node,
-                    grant,
-                    TaskResult(exc=exc, traceback_str=traceback.format_exc()),
-                )
-                return
-            with env_cm:
-                result = _run_callable(spec.func, args, kwargs)
-                result = _maybe_consume_stream(spec, result)
-            self._on_task_done(spec, self.node, grant, result)
+                try:
+                    args, kwargs = resolve_args(spec)
+                    # Env staging can fail (missing working_dir): must
+                    # surface as the task's failure, never escape into the
+                    # pool and hang the caller with the grant leaked.
+                    env_cm = _activate_runtime_env(spec)
+                except BaseException as exc:  # dep was freed/lost, bad env
+                    self._on_task_done(
+                        spec,
+                        self.node,
+                        grant,
+                        TaskResult(exc=exc, traceback_str=traceback.format_exc()),
+                    )
+                    return
+                with env_cm:
+                    result = _run_callable(spec.func, args, kwargs)
+                    result = _maybe_consume_stream(spec, result)
+                self._on_task_done(spec, self.node, grant, result)
+            finally:
+                tracing.deactivate(_trace_token)
 
         self._pool.submit(run)
 
@@ -311,6 +319,8 @@ class ActorExecutor:
     # -- execution -----------------------------------------------------------
 
     def _set_context(self, spec: TaskSpec) -> None:
+        from ray_tpu.util import tracing
+
         CONTEXT.task_id = spec.task_id
         CONTEXT.job_id = spec.job_id
         CONTEXT.node_id = self.node.node.node_id
@@ -318,6 +328,7 @@ class ActorExecutor:
         CONTEXT.task_name = spec.name
         CONTEXT.resource_grant = self.grant
         CONTEXT.put_counter = 0
+        tracing.activate_task(spec)
 
     def _main(self) -> None:
         # Run the creation task (constructor) first; its single return object

@@ -406,6 +406,7 @@ class ProcessWorkerHandle(WorkerChannel):
             "method_name": spec.method_name,
             "actor_id": spec.actor_id.binary() if spec.actor_id else None,
             "max_concurrency": spec.max_concurrency,
+            "trace_ctx": spec.trace_ctx,
             "runtime_env": spec.runtime_env,
             "grant": dict(grant),
             # args/kwargs are user data: nested as a separately-pickled blob
@@ -516,6 +517,8 @@ class ProcessWorkerHandle(WorkerChannel):
 
     def _handle_frame(self, kind: str, body: dict) -> None:
         if kind == "done":
+            for span in body.get("spans", ()):
+                self.runtime.user_spans.append(span)
             self._handle_done(body)
         elif kind == "stream_item":
             with self._lock:

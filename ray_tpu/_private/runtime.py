@@ -100,6 +100,12 @@ def _as_instanceof_cause(err: TaskError) -> BaseException:
         return err
 
 
+def _capture_trace() -> Optional[tuple]:
+    from ray_tpu.util import tracing
+
+    return tracing.capture_context()
+
+
 def _default_store_budget(config: Config) -> Optional[int]:
     """30% of system RAM capped at 200GB (reference: ray_constants.py:51-53)."""
     try:
@@ -240,6 +246,8 @@ class Runtime:
         self.logs = LogBuffer()
         if self.config.log_to_driver:
             self.logs.add_sink(print_batch_to_driver)
+        # User spans shipped home by workers (util/tracing.py traces()).
+        self.user_spans: deque = deque(maxlen=10_000)
         from ray_tpu._private.runtime_env import RuntimeEnvManager
 
         self.runtime_env_manager = RuntimeEnvManager()
@@ -643,9 +651,17 @@ class Runtime:
                 self.store.invalidate(ret)
             with self._lock:
                 self._task_records[spec.task_id] = _TaskRecord(spec, request)
+            from ray_tpu.util import tracing as _tracing
+
+            trace_ctx = spec.trace_ctx
             self.task_events.record(
                 spec.task_id, "PENDING_ARGS_AVAIL", name=spec.name,
                 kind="RECOVERY", job_id=spec.job_id,
+                trace_id=(
+                    trace_ctx[0] if trace_ctx
+                    else _tracing.task_span_id(spec.task_id)
+                ),
+                parent_span_id=trace_ctx[1] if trace_ctx else None,
             )
             self._submit_when_ready(spec, request)
             return True
@@ -683,6 +699,7 @@ class Runtime:
         max_retries: int,
         retry_exceptions: Any,
         runtime_env: Optional[dict] = None,
+        trace_ctx: Optional[tuple] = None,
     ) -> list[ObjectRef]:
         from ray_tpu._private.runtime_env import validate_runtime_env
 
@@ -706,6 +723,7 @@ class Runtime:
             retry_exceptions=retry_exceptions,
             runtime_env=runtime_env,
             parent_task_id=self.current_task_id(),
+            trace_ctx=trace_ctx or _capture_trace(),
         )
         spec.compute_return_ids()
         refs = []
@@ -790,6 +808,9 @@ class Runtime:
         stream.finish(total)
 
     def _record_pending(self, spec: TaskSpec, request: Optional[dict] = None) -> None:
+        from ray_tpu.util import tracing
+
+        trace_ctx = spec.trace_ctx
         self.task_events.record(
             spec.task_id,
             "PENDING_ARGS_AVAIL",
@@ -798,6 +819,11 @@ class Runtime:
             job_id=spec.job_id,
             actor_id=spec.actor_id,
             required_resources=request,
+            trace_id=(
+                trace_ctx[0] if trace_ctx
+                else tracing.task_span_id(spec.task_id)
+            ),
+            parent_span_id=trace_ctx[1] if trace_ctx else None,
         )
 
     def _submit_when_ready(self, spec: TaskSpec, request: dict[str, float]) -> None:
@@ -839,6 +865,7 @@ class Runtime:
         max_concurrency: int,
         detached: bool,
         runtime_env: Optional[dict] = None,
+        trace_ctx: Optional[tuple] = None,
         isolation: Optional[str] = None,
     ) -> tuple[ActorID, ObjectRef]:
         from ray_tpu._private.runtime_env import validate_runtime_env
@@ -863,6 +890,7 @@ class Runtime:
             runtime_env=runtime_env,
             parent_task_id=self.current_task_id(),
             isolation=isolation,
+            trace_ctx=trace_ctx or _capture_trace(),
         )
         spec.compute_return_ids()
         record = ActorRecord(
@@ -896,6 +924,7 @@ class Runtime:
         *,
         name: str,
         num_returns: int,
+        trace_ctx: Optional[tuple] = None,
     ) -> list[ObjectRef]:
         record = self.controller.get_actor_record(actor_id)
         if record is None:
@@ -917,6 +946,7 @@ class Runtime:
             max_retries=0 if streaming else (creation.max_task_retries if creation else 0),
             retry_exceptions=False,
             parent_task_id=self.current_task_id(),
+            trace_ctx=trace_ctx or _capture_trace(),
         )
         spec.compute_return_ids()
         refs = []

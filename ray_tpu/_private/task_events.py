@@ -29,6 +29,10 @@ class TaskEvent:
     error_type: str = ""
     error_message: str = ""
     required_resources: Dict[str, float] = field(default_factory=dict)
+    # Trace linkage (util/tracing.py): which trace this task-span belongs to
+    # and the span it nests under.
+    trace_id: str = ""
+    parent_span_id: Optional[str] = None
 
     @property
     def state(self) -> str:
@@ -67,6 +71,8 @@ class TaskEventBuffer:
         error_type: str = "",
         error_message: str = "",
         required_resources: Optional[dict] = None,
+        trace_id: str = "",
+        parent_span_id: Optional[str] = None,
     ) -> None:
         now = time.time()
         with self._lock:
@@ -88,6 +94,10 @@ class TaskEventBuffer:
                 ev.actor_id = actor_id
             if node_id is not None:
                 ev.node_id = node_id
+            if trace_id:
+                ev.trace_id = trace_id
+            if parent_span_id is not None:
+                ev.parent_span_id = parent_span_id
             if error_type:
                 ev.error_type = error_type
             if error_message:

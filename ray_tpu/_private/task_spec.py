@@ -51,6 +51,10 @@ class TaskSpec:
     return_ids: list[ObjectID] = field(default_factory=list)
     # Owner context (the submitting task), for lineage:
     parent_task_id: Optional[TaskID] = None
+    # Trace propagation (util/tracing.py, the tracing_helper metadata
+    # analog): (trace_id, parent_span_id) captured at submission so spans
+    # nest across workers and nodes. None = this task roots a new trace.
+    trace_ctx: Optional[tuple] = None
 
     def compute_return_ids(self) -> list[ObjectID]:
         self.return_ids = [

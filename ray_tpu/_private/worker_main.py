@@ -149,6 +149,14 @@ class _NoopTaskEvents:
         pass
 
 
+def _trace_ctx():
+    """The worker's ambient trace context, shipped with submissions so the
+    head parents the new task correctly (tracing_helper's _inject)."""
+    from ray_tpu.util import tracing
+
+    return tracing.capture_context()
+
+
 class WorkerProxyRuntime:
     """Runtime facade inside a worker process: every ownership-bearing
     operation is an RPC to the driver (the owner); reads of shm-resident
@@ -269,7 +277,7 @@ class WorkerProxyRuntime:
                 "func": cloudpickle.dumps(func, protocol=5),
                 "args": args,
                 "kwargs": kwargs,
-                "options": options,
+                "options": {**options, "trace_ctx": _trace_ctx()},
                 "parent_task_id": self.current_task_id().binary(),
             },
         )
@@ -285,7 +293,7 @@ class WorkerProxyRuntime:
                 "cls": cloudpickle.dumps(cls, protocol=5),
                 "args": args,
                 "kwargs": kwargs,
-                "options": options,
+                "options": {**options, "trace_ctx": _trace_ctx()},
             },
         )
         ref = self._refs_from_reply([reply["creation_ref"]])[0]
@@ -299,7 +307,7 @@ class WorkerProxyRuntime:
                 "method_name": method_name,
                 "args": args,
                 "kwargs": kwargs,
-                "options": options,
+                "options": {**options, "trace_ctx": _trace_ctx()},
             },
         )
         refs = self._refs_from_reply(reply["refs"])
@@ -507,10 +515,12 @@ class Worker:
             actor_id=ActorID(body["actor_id"]) if body.get("actor_id") else None,
             max_concurrency=body.get("max_concurrency", 1),
             runtime_env=body.get("runtime_env"),
+            trace_ctx=tuple(body["trace_ctx"]) if body.get("trace_ctx") else None,
         )
 
     def _set_context(self, body: dict, spec: TaskSpec) -> None:
         from ray_tpu._private.engine import CONTEXT
+        from ray_tpu.util import tracing
 
         CONTEXT.task_id = spec.task_id
         CONTEXT.job_id = self.job_id
@@ -519,6 +529,7 @@ class Worker:
         CONTEXT.task_name = spec.name
         CONTEXT.resource_grant = body.get("grant", {})
         CONTEXT.put_counter = 0
+        tracing.activate_task(spec)
 
     def _resolve(self, body: dict) -> tuple[tuple, dict]:
         def materialize(value):
@@ -535,11 +546,19 @@ class Worker:
         return args, kwargs
 
     def _send_done(self, spec: TaskSpec, result) -> None:
+        from ray_tpu.util import tracing
+
         body = {
             "task_id": spec.task_id.binary(),
             "cancelled": result.cancelled,
             "tb": result.traceback_str,
         }
+        # User spans opened inside this task ride home with its result so
+        # head-side traces() sees a complete tree (tracing_helper exports
+        # via the driver; here the done frame is the export channel).
+        spans = tracing._buffer.drain()
+        if spans:
+            body["spans"] = [s.to_dict() for s in spans]
         if result.exc is not None:
             # Exceptions are user data: ship pre-pickled so a class the
             # driver can't unpickle degrades to a task error there instead

@@ -167,6 +167,12 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/api/timeline":
             self._json(runtime.task_events.chrome_trace())
+        elif path == "/api/traces":
+            from ray_tpu.util import tracing
+
+            self._json(
+                tracing.traces(trace_id=q.get("trace_id"), runtime=runtime)
+            )
         elif path == "/metrics":
             self._send(200, metrics.prometheus_text().encode(), "text/plain")
         else:

@@ -5,6 +5,7 @@ from ray_tpu.util.placement_group import (
     remove_placement_group,
 )
 from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util import tracing
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -18,4 +19,5 @@ __all__ = [
     "inspect_serializability",
     "placement_group",
     "remove_placement_group",
+    "tracing",
 ]

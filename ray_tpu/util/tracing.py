@@ -1,0 +1,202 @@
+"""Distributed tracing: task-span propagation + user spans.
+
+Reference: ray/util/tracing/tracing_helper.py:289,322 — OpenTelemetry
+contexts are serialized into task metadata on submit and re-entered around
+execution, so spans nest across process boundaries. The sealed image has no
+opentelemetry, so this is the same propagation contract on a lean native
+span model:
+
+  * every task IS a span: span_id derives from the task id, the parent is
+    the ambient span (enclosing task or user span) at submission, and the
+    trace_id flows through TaskSpec.trace_ctx across workers and nodes;
+  * `with tracing.span("name"):` opens a user span under the ambient one —
+    inside tasks too (the worker re-enters the task's context before user
+    code runs);
+  * task spans are assembled head-side from the task-event buffer (state
+    transitions already carry start/end/node); user spans record into a
+    process-local buffer. `traces()` merges both views.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_ambient: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace", default=None
+)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    span_id: str
+
+    def as_tuple(self) -> tuple:
+        return (self.trace_id, self.span_id)
+
+
+def task_span_id(task_id) -> str:
+    """Stable span id for a task (reused on retries: a retry is the same
+    logical span re-executed)."""
+    return task_id.hex()[:16]
+
+
+def capture_context() -> Optional[tuple]:
+    """The (trace_id, span_id) to parent a new task under, or None when
+    nothing is being traced here (the submission becomes a trace root)."""
+    ctx = _ambient.get()
+    return ctx.as_tuple() if ctx is not None else None
+
+
+def activate_task(spec) -> contextvars.Token:
+    """Enter a task's trace context around its execution (the execution-side
+    half of tracing_helper's _inject/_extract pair). The task's own span id
+    becomes the ambient parent for everything inside."""
+    trace_ctx = getattr(spec, "trace_ctx", None)
+    trace_id = trace_ctx[0] if trace_ctx else task_span_id(spec.task_id)
+    return _ambient.set(TraceContext(trace_id, task_span_id(spec.task_id)))
+
+
+def deactivate(token: contextvars.Token) -> None:
+    try:
+        _ambient.reset(token)
+    except Exception:
+        pass
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str]
+    name: str
+    start_s: float
+    end_s: Optional[float] = None
+    kind: str = "user"  # "user" | "task"
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "name": self.name,
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+            "duration_s": (self.end_s - self.start_s) if self.end_s else None,
+            "kind": self.kind,
+            "attributes": dict(self.attributes),
+        }
+
+
+class SpanBuffer:
+    """Process-local bounded store of finished user spans."""
+
+    def __init__(self, capacity: int = 10_000):
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._capacity = capacity
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self._capacity:
+                self._spans = self._spans[-self._capacity:]
+
+    def drain(self) -> List[Span]:
+        with self._lock:
+            out, self._spans = self._spans, []
+            return out
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+
+_buffer = SpanBuffer()
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[dict] = None):
+    """Open a user span under the ambient context (task or enclosing span);
+    new tasks submitted inside it are parented to it."""
+    parent = _ambient.get()
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        trace_id, parent_id = uuid.uuid4().hex[:16], None
+    record = Span(
+        trace_id=trace_id,
+        span_id=uuid.uuid4().hex[:16],
+        parent_span_id=parent_id,
+        name=name,
+        start_s=time.time(),
+        attributes=dict(attributes or {}),
+    )
+    token = _ambient.set(TraceContext(trace_id, record.span_id))
+    try:
+        yield record
+    finally:
+        _ambient.reset(token)
+        record.end_s = time.time()
+        _buffer.add(record)
+
+
+def local_spans() -> List[dict]:
+    """Finished user spans recorded in THIS process."""
+    return [s.to_dict() for s in _buffer.snapshot()]
+
+
+def traces(trace_id: Optional[str] = None, runtime=None) -> List[dict]:
+    """All spans the head can see: task spans assembled from the task-event
+    buffer (cross-node — events flow back with task completion), user spans
+    workers shipped with their results, and this process's local user
+    spans. Filterable by trace_id. In a worker (or before init) this
+    degrades to the process-local user spans."""
+    rows: List[dict] = []
+    if runtime is None:
+        try:
+            from ray_tpu._private.runtime import get_runtime
+
+            runtime = get_runtime()
+        except Exception:
+            runtime = None
+    events = getattr(runtime, "task_events", None)
+    if events is not None and hasattr(events, "list_events"):
+        for ev in events.list_events():
+            start = ev.state_times.get("RUNNING") or ev.state_times.get(
+                "PENDING_NODE_ASSIGNMENT"
+            )
+            end = ev.state_times.get("FINISHED") or ev.state_times.get("FAILED")
+            if start is None:
+                continue
+            rows.append(
+                Span(
+                    trace_id=getattr(ev, "trace_id", "") or task_span_id(ev.task_id),
+                    span_id=task_span_id(ev.task_id),
+                    parent_span_id=getattr(ev, "parent_span_id", None),
+                    name=ev.name,
+                    start_s=start,
+                    end_s=end,
+                    kind="task",
+                    attributes={
+                        "state": ev.state,
+                        "node_id": ev.node_id.hex() if ev.node_id else None,
+                        "task_id": ev.task_id.hex(),
+                    },
+                ).to_dict()
+            )
+    remote = getattr(runtime, "user_spans", None)
+    if remote:
+        rows.extend(dict(r) for r in list(remote))
+    rows.extend(local_spans())
+    if trace_id is not None:
+        rows = [r for r in rows if r["trace_id"] == trace_id]
+    rows.sort(key=lambda r: r["start_s"])
+    return rows

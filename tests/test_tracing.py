@@ -1,0 +1,129 @@
+"""Distributed tracing: span propagation across tasks/actors/processes.
+
+Reference contract: util/tracing/tracing_helper.py — submission injects the
+ambient context into task metadata; execution re-enters it, so spans nest
+across process boundaries."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_user_spans_nest(rt):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            pass
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_span_id == outer.span_id
+    rows = tracing.local_spans()
+    names = [r["name"] for r in rows]
+    assert "outer" in names and "inner" in names
+
+
+def test_task_spans_link_across_nesting(rt):
+    runtime = rt
+
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    with tracing.span("driver-op") as root:
+        assert ray_tpu.get(parent.remote()) == 1
+
+    rows = tracing.traces(trace_id=root.trace_id)
+    by_name = {r["name"]: r for r in rows}
+    assert "driver-op" in by_name
+    parent_span = next(r for r in rows if r["name"].endswith("parent"))
+    child_span = next(r for r in rows if r["name"].endswith(".child"))
+    # parent task nests under the driver span; child under the parent task.
+    assert parent_span["parent_span_id"] == root.span_id
+    assert child_span["parent_span_id"] == parent_span["span_id"]
+    assert child_span["trace_id"] == root.trace_id
+    assert parent_span["kind"] == "task"
+    assert parent_span["duration_s"] is not None
+
+
+def test_actor_task_spans(rt):
+    @ray_tpu.remote
+    class Act:
+        def ping(self):
+            return "pong"
+
+    with tracing.span("actor-root") as root:
+        a = Act.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+    rows = tracing.traces(trace_id=root.trace_id)
+    names = {r["name"] for r in rows}
+    assert "Act.ping" in names or any("ping" in n for n in names)
+
+
+def test_trace_propagates_through_process_workers():
+    runtime = ray_tpu.init(
+        num_cpus=2, _system_config={"isolation": "process"}
+    )
+    try:
+        @ray_tpu.remote
+        def grandchild():
+            return 7
+
+        @ray_tpu.remote
+        def child():
+            # Submitted FROM a worker process: the trace context crossed the
+            # wire in and must cross back out with this submission.
+            return ray_tpu.get(grandchild.remote())
+
+        with tracing.span("xproc") as root:
+            assert ray_tpu.get(child.remote()) == 7
+        rows = tracing.traces(trace_id=root.trace_id)
+        names = {r["name"] for r in rows}
+        assert any(n.endswith(".child") for n in names)
+        assert any(n.endswith("grandchild") for n in names)
+        child_span = next(r for r in rows if r["name"].endswith(".child"))
+        gchild_span = next(r for r in rows if r["name"].endswith("grandchild"))
+        assert gchild_span["parent_span_id"] == child_span["span_id"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_user_spans_ship_home():
+    """User spans opened INSIDE process-isolated tasks ride back with the
+    task result, so the head-side trace tree has no dangling parents."""
+    runtime = ray_tpu.init(
+        num_cpus=2, _system_config={"isolation": "process"}
+    )
+    try:
+        @ray_tpu.remote
+        def leaf():
+            return 1
+
+        @ray_tpu.remote
+        def with_span():
+            with tracing.span("inside-worker"):
+                return ray_tpu.get(leaf.remote())
+
+        with tracing.span("root") as root:
+            assert ray_tpu.get(with_span.remote()) == 1
+        rows = tracing.traces(trace_id=root.trace_id)
+        by_name = {r["name"]: r for r in rows}
+        assert "inside-worker" in by_name, sorted(by_name)
+        inner = by_name["inside-worker"]
+        # The leaf task nests under the worker-side user span.
+        leaf_span = next(r for r in rows if r["name"].endswith("leaf"))
+        assert leaf_span["parent_span_id"] == inner["span_id"]
+        # And the user span itself nests under its enclosing task span.
+        task_span = next(r for r in rows if r["name"].endswith("with_span"))
+        assert inner["parent_span_id"] == task_span["span_id"]
+    finally:
+        ray_tpu.shutdown()
