@@ -130,10 +130,38 @@ class _MapWorker:
 # -- stage iterators ----------------------------------------------------------
 
 
+def _tracked(
+    stream: Iterator[RefBundle], stats: Optional[dict], name: str
+) -> Iterator[RefBundle]:
+    """Wrap a stage's output stream with per-op accounting: blocks/rows/
+    bytes produced, per-task execution wall times, and the stage's streaming
+    wall clock (reference: data/_internal/stats.py per-operator stats — the
+    main input-pipeline perf-debugging surface)."""
+    if stats is None:
+        yield from stream
+        return
+    s = stats.setdefault(
+        name,
+        {"blocks": 0, "rows": 0, "bytes": 0, "task_wall_s": [], "wall_s": 0.0},
+    )
+    t0 = time.perf_counter()
+    for ref, meta in stream:
+        s["blocks"] += 1
+        if meta.num_rows is not None:
+            s["rows"] += meta.num_rows
+        if meta.size_bytes is not None:
+            s["bytes"] += meta.size_bytes
+        wall = (meta.exec_stats or {}).get("wall_s")
+        if wall is not None:
+            s["task_wall_s"].append(wall)
+        s["wall_s"] = time.perf_counter() - t0
+        yield ref, meta
+    s["wall_s"] = time.perf_counter() - t0
+
+
 def _iter_map_stage(
     upstream: Iterator[RefBundle],
     ops: List[Any],
-    stats: Optional[dict] = None,
 ) -> Iterator[RefBundle]:
     """Bounded-in-flight, order-preserving task pipeline over blocks."""
     compute = next((op.compute for op in ops if op.compute is not None), None)
@@ -150,7 +178,6 @@ def _iter_map_stage(
     pending: deque = deque()
     upstream = iter(upstream)
     exhausted = False
-    t_start = time.perf_counter()
     while True:
         while not exhausted and len(pending) < DEFAULT_MAX_IN_FLIGHT:
             try:
@@ -164,8 +191,6 @@ def _iter_map_stage(
         block_ref, meta_ref = pending.popleft()
         meta = ray_tpu.get(meta_ref)
         yield block_ref, meta
-    if stats is not None:
-        stats.setdefault(name, {})["wall_s"] = time.perf_counter() - t_start
 
 
 def _iter_actor_pool_stage(
@@ -257,6 +282,13 @@ def _materialize(upstream: Iterator[RefBundle]) -> List[RefBundle]:
     return list(upstream)
 
 
+def _resolve_bundles(outs: List[Tuple[Any, Any]]) -> Iterator[RefBundle]:
+    """Resolve (block_ref, meta_ref) pairs with ONE batched get — per-block
+    gets would serialize a round trip per output block."""
+    metas = ray_tpu.get([meta_ref for _, meta_ref in outs])
+    yield from zip([ref for ref, _ in outs], metas)
+
+
 def _split_block_task(block: Any, n: int):
     """Split one block into n near-equal slices (repartition fan-out).
 
@@ -297,10 +329,11 @@ def _repartition(bundles: List[RefBundle], n: int) -> Iterator[RefBundle]:
         for block_ref, _ in bundles
     ]
     # parts[j] = n refs of block j's slices.
+    outs = []
     for i in range(n):
         shard_refs = [p[i] if n > 1 else p for p in parts]
-        ref, meta_ref = concat.remote(*shard_refs)
-        yield ref, ray_tpu.get(meta_ref)
+        outs.append(concat.remote(*shard_refs))
+    yield from _resolve_bundles(outs)
 
 
 def _shuffle_block_task(block: Any, seed):
@@ -324,11 +357,11 @@ def _random_shuffle(
     shuffle_one = ray_tpu.remote(_shuffle_block_task).options(num_returns=2)
     repartitioned = list(_repartition(bundles, n))
     rng.shuffle(repartitioned)
-    for i, (block_ref, _) in enumerate(repartitioned):
-        ref, meta_ref = shuffle_one.remote(
-            block_ref, None if seed is None else seed + i
-        )
-        yield ref, ray_tpu.get(meta_ref)
+    outs = [
+        shuffle_one.remote(block_ref, None if seed is None else seed + i)
+        for i, (block_ref, _) in enumerate(repartitioned)
+    ]
+    yield from _resolve_bundles(outs)
 
 
 def _sort_block_task(block: Any, key, descending: bool):
@@ -414,10 +447,11 @@ def _sort(
         partition.options(num_returns=n).remote(ref, key, boundaries, descending)
         for ref, _ in bundles
     ]
+    outs = []
     for i in range(n):
         shard = [p[i] if n > 1 else p for p in parts]
-        ref, meta_ref = merge.remote(key, descending, *shard)
-        yield ref, ray_tpu.get(meta_ref)
+        outs.append(merge.remote(key, descending, *shard))
+    yield from _resolve_bundles(outs)
 
 
 def _zip_blocks_task(a: Any, b: Any):
@@ -475,6 +509,19 @@ def execute_streaming(
     """Compile the logical plan into chained stage iterators and stream."""
     stream: Optional[Iterator[RefBundle]] = None
     ops = list(plan.ops)
+    if stats is not None:
+        # stats reflect the LATEST execution (re-iterating a Dataset re-runs
+        # the plan; mixing epochs would fabricate counts).
+        stats.clear()
+
+    def _stage_key(base: str) -> str:
+        if stats is None or base not in stats:
+            return base
+        k = 2
+        while f"{base} ({k})" in stats:
+            k += 1
+        return f"{base} ({k})"
+
     i = 0
     while i < len(ops):
         op = ops[i]
@@ -488,7 +535,10 @@ def execute_streaming(
             while j < len(ops) and ops[j].is_one_to_one() and ops[j].compute is None:
                 fused.append(ops[j])
                 j += 1
-            stream = _iter_read_stage(op.read_tasks, fused)
+            stage = "Read" + ("->" + "+".join(f.name for f in fused) if fused else "")
+            stream = _tracked(
+                _iter_read_stage(op.read_tasks, fused), stats, _stage_key(stage)
+            )
             i = j
         elif op.is_one_to_one():
             # Fuse only stages with identical compute specs — fusing actor
@@ -503,7 +553,11 @@ def execute_streaming(
             ):
                 fused.append(ops[j])
                 j += 1
-            stream = _iter_map_stage(stream, fused, stats)
+            stream = _tracked(
+                _iter_map_stage(stream, fused),
+                stats,
+                _stage_key("+".join(f.name for f in fused)),
+            )
             i = j
         elif isinstance(op, Limit):
             stream = _iter_limit_stage(stream, op.limit)
@@ -519,18 +573,25 @@ def execute_streaming(
                 )
 
                 def _shuffled(parts):
-                    for ref, _meta in parts:
-                        # seed=None → fresh permutation every plan execution
-                        # (each epoch re-runs the plan and must re-shuffle).
-                        out_ref, meta_ref = shuffle_one.remote(ref, None)
-                        yield out_ref, ray_tpu.get(meta_ref)
+                    # seed=None → fresh permutation every plan execution
+                    # (each epoch re-runs the plan and must re-shuffle).
+                    outs = [shuffle_one.remote(ref, None) for ref, _ in parts]
+                    yield from _resolve_bundles(outs)
 
-                stream = _shuffled(list(_repartition(bundles, op.num_blocks)))
+                stream = _tracked(
+                    _shuffled(list(_repartition(bundles, op.num_blocks))),
+                    stats, _stage_key("Repartition(shuffle)"),
+                )
             else:
-                stream = _repartition(bundles, op.num_blocks)
+                stream = _tracked(
+                    _repartition(bundles, op.num_blocks), stats, _stage_key("Repartition")
+                )
             i += 1
         elif isinstance(op, RandomShuffle):
-            stream = _random_shuffle(_materialize(stream), op.seed)
+            stream = _tracked(
+                _random_shuffle(_materialize(stream), op.seed),
+                stats, _stage_key("RandomShuffle"),
+            )
             i += 1
         elif isinstance(op, RandomizeBlockOrder):
             import random as _random
@@ -540,7 +601,10 @@ def execute_streaming(
             stream = iter(bundles)
             i += 1
         elif isinstance(op, Sort):
-            stream = _sort(_materialize(stream), op.key, op.descending)
+            stream = _tracked(
+                _sort(_materialize(stream), op.key, op.descending),
+                stats, _stage_key("Sort"),
+            )
             i += 1
         elif isinstance(op, Union):
             def _union(base, others):

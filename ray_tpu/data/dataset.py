@@ -404,11 +404,30 @@ class Dataset:
     # ------------------------------------------------------------------
 
     def stats(self) -> str:
-        """Per-stage wall-time breakdown (reference data/_internal/stats.py —
-        the main input-pipeline perf tool; populated during execution)."""
+        """Per-operator execution breakdown (reference data/_internal/stats.py
+        — the main input-pipeline perf tool; populated during execution):
+        blocks/rows/bytes produced, task wall-time distribution, and the
+        stage's streaming wall clock."""
         lines = [f"Dataset plan: {self._plan.describe()}"]
-        for stage, s in self._stats.items():
-            lines.append(f"  {stage}: {s.get('wall_s', 0.0)*1000:.1f}ms")
+        for idx, (stage, s) in enumerate(self._stats.items(), 1):
+            blocks = s.get("blocks", 0)
+            wall = s.get("wall_s", 0.0)
+            lines.append(
+                f"Stage {idx} {stage}: {blocks} blocks produced in {wall:.2f}s"
+            )
+            if s.get("rows"):
+                lines.append(f"* Output rows: {s['rows']} total")
+            if s.get("bytes"):
+                lines.append(f"* Output size bytes: {s['bytes']} total")
+            walls = s.get("task_wall_s") or []
+            if walls:
+                lines.append(
+                    f"* Tasks: {len(walls)}; task wall time: "
+                    f"{min(walls)*1e3:.1f}ms min, "
+                    f"{sum(walls)/len(walls)*1e3:.1f}ms mean, "
+                    f"{max(walls)*1e3:.1f}ms max, "
+                    f"{sum(walls)*1e3:.1f}ms total"
+                )
         return "\n".join(lines)
 
     def __repr__(self):

@@ -23,11 +23,16 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     run,
     shutdown,
+    start,
     status,
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve import schema
 
@@ -38,6 +43,7 @@ __all__ = [
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "batch",
     "deployment",
     "get_app_handle",
@@ -47,5 +53,6 @@ __all__ = [
     "run",
     "schema",
     "shutdown",
+    "start",
     "status",
 ]

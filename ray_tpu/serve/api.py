@@ -212,6 +212,89 @@ def get_app_handle(app_name: str = _DEFAULT_APP) -> DeploymentHandle:
     return DeploymentHandle(app_name, next(iter(app)))
 
 
+class _NodeProxyActor:
+    """Actor shell hosting an HTTPProxyActor on whatever node it lands on
+    (reference: one HTTPProxyActor per node, serve/_private/proxy.py).
+    Binds all interfaces and advertises the machine's outward-facing
+    address so off-node clients can reach it."""
+
+    def __init__(self, port: int, request_timeout_s: float,
+                 probe_host: Optional[str] = None):
+        from ray_tpu.serve._private.http_proxy import HTTPProxyActor
+
+        self._probe_host = probe_host
+        self._proxy = HTTPProxyActor("0.0.0.0", port, request_timeout_s)
+
+    def address(self) -> tuple:
+        import socket as _socket
+
+        _, port = self._proxy.address()
+        # The interface used to reach the head is the address peers can
+        # reach US at (node_daemon._advertise_host's trick); hostname
+        # resolution is the single-machine fallback.
+        if self._probe_host:
+            try:
+                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                probe.connect((self._probe_host, 1))
+                host = probe.getsockname()[0]
+                probe.close()
+                return (host, port)
+            except OSError:
+                pass
+        try:
+            return (_socket.gethostbyname(_socket.gethostname()), port)
+        except OSError:
+            return ("127.0.0.1", port)
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self) -> None:
+        self._proxy.shutdown()
+
+
+_node_proxies: list = []
+
+
+def start(
+    proxy_location: str = "HeadOnly",
+    http_host: str = "127.0.0.1",
+    http_port: int = 0,
+    request_timeout_s: float = 60.0,
+) -> list:
+    """Start HTTP ingress proxies (reference: serve.start + per-node
+    HTTPProxyActor placement). "HeadOnly" runs one in-process proxy;
+    "EveryNode" additionally pins one proxy ACTOR to every alive node (port
+    0 = ephemeral per node). Returns [(host, port), ...]."""
+    from ray_tpu import api as ray
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.serve._private.http_proxy import start_proxy
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    addresses = [start_proxy(http_host, http_port, request_timeout_s)]
+    if proxy_location == "EveryNode":
+        if _node_proxies:
+            # Idempotent: the node fleet is already up; report it.
+            return addresses + [addr for _, addr in _node_proxies]
+        runtime = get_runtime()
+        head = getattr(runtime, "_head_server", None)
+        probe_host = head.host if head else None
+        proxy_cls = ray.remote(_NodeProxyActor)
+        for node in runtime.controller.alive_nodes():
+            actor = proxy_cls.options(
+                num_cpus=0,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node.node_id.hex(), soft=False
+                ),
+            ).remote(0, request_timeout_s, probe_host)
+            addr = tuple(ray.get(actor.address.remote()))
+            addresses.append(addr)
+            _node_proxies.append((actor, addr))
+    return addresses
+
+
 def status() -> dict:
     from ray_tpu import api as ray
     from ray_tpu.serve._private.controller import get_or_create_controller
@@ -226,9 +309,23 @@ def shutdown() -> None:
         CONTROLLER_NAME,
         get_or_create_controller,
     )
+    from ray_tpu.serve._private.http_proxy import stop_proxy
 
     if not ray.is_initialized():
         return
+    stop_proxy()
+    global _node_proxies
+    proxies, _node_proxies = _node_proxies, []
+    for actor, _addr in proxies:
+        try:
+            ray.get(actor.shutdown.remote(), timeout=10.0)
+        except Exception:
+            pass
+        finally:
+            try:
+                ray.kill(actor)  # force-kill even if graceful stop hung
+            except Exception:
+                pass
     runtime = get_runtime()
     existing = runtime.controller.get_named_actor(
         CONTROLLER_NAME, runtime.namespace

@@ -372,3 +372,20 @@ def test_streaming_split_many_blocks_shared_coordinator(ray_start_regular):
     t0.join(timeout=60); t1.join(timeout=60)
     assert not t0.is_alive() and not t1.is_alive(), "streaming_split deadlocked"
     assert sorted(seen[0] + seen[1]) == list(range(200))
+
+
+def test_stats_per_operator_breakdown(ray_start_regular):
+    """ds.stats() reports blocks/rows/bytes and task wall-time distribution
+    per operator (the reference's main input-pipeline perf tool)."""
+    ds = (
+        rd.range(600)
+        .map_batches(lambda b: {"x": b["id"] * 2})
+        .random_shuffle(seed=7)
+    )
+    ds.take_all()
+    report = ds.stats()
+    assert "Stage 1 Read->MapBatches" in report
+    assert "Output rows: 600 total" in report
+    assert "Output size bytes:" in report
+    assert "task wall time:" in report and "mean" in report
+    assert "RandomShuffle" in report

@@ -623,3 +623,27 @@ def test_streaming_handle_direct(serve_instance):
     handle = serve.run(gen_app.bind(), name="genapp")
     items = list(handle.options(stream=True).remote(4))
     assert items == [0, 10, 20, 30]
+
+
+def test_per_node_proxies(serve_instance):
+    """serve.start(proxy_location="EveryNode") pins one ingress proxy actor
+    per alive node; every proxy serves the same applications."""
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    runtime.add_node({"CPU": 2})  # a second logical node
+
+    @serve.deployment
+    def echo(x):
+        return {"v": x}
+
+    serve.run(echo.bind(), name="echoapp")
+    addresses = serve.start(proxy_location="EveryNode")
+    # head in-process proxy + one actor per node
+    assert len(addresses) == 1 + len(runtime.controller.alive_nodes())
+    for host, port in addresses:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/echoapp", data=json.dumps(11).encode()
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["result"] == {"v": 11}
