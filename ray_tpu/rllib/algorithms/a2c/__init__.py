@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.a2c.a2c import A2C, A2CConfig, A2CLearner
+
+__all__ = ["A2C", "A2CConfig", "A2CLearner"]

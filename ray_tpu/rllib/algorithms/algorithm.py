@@ -61,6 +61,7 @@ class AlgorithmConfig:
         self.policy_mapping_fn: Optional[Any] = None
         # offline IO: directory to tee sampled rollouts into (JsonWriter)
         self.output: Optional[str] = None
+        self.input_: Optional[str] = None  # offline dataset dir (BC/CQL)
         # debugging / reproducibility
         self.seed: Optional[int] = 0
         # internal
@@ -98,6 +99,13 @@ class AlgorithmConfig:
             self.restart_failed_env_runners = restart_failed_env_runners
         if observation_filter is not None:
             self.observation_filter = observation_filter
+        return self
+
+    def offline_data(self, *, input_=None) -> "AlgorithmConfig":
+        """Directory of .jsonl batches for offline algorithms (BC/CQL);
+        the output side (`output=`) lives in training()."""
+        if input_ is not None:
+            self.input_ = input_
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

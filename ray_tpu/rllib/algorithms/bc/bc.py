@@ -22,14 +22,8 @@ class BCConfig(AlgorithmConfig):
         super().__init__(algo_class=algo_class or BC)
         self.lr = 1e-3
         self.train_batch_size = 256
-        self.input_ = None  # directory of .jsonl batches (offline_data())
         self.bc_logstd_coeff = 0.0
         self._compute_gae_on_runner = False
-
-    def offline_data(self, *, input_=None) -> "BCConfig":
-        if input_ is not None:
-            self.input_ = input_
-        return self
 
     def get_default_learner_class(self):
         return BCLearner

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig, CQLLearner
+
+__all__ = ["CQL", "CQLConfig", "CQLLearner"]

@@ -1,0 +1,120 @@
+"""CQL: conservative Q-learning from offline data (Kumar et al. 2020).
+
+Reference: rllib/algorithms/cql/cql.py — SAC's actor/twin-critic machinery
+trained purely from a logged dataset, with the conservative regularizer
+
+    alpha_cql * E_s[ logsumexp_a Q(s, a) - Q(s, a_data) ]
+
+pushing Q down on out-of-distribution actions (sampled from the uniform
+prior and the current policy) and up on dataset actions. Reuses SACLearner's
+entire loss; only the penalty and the offline data source differ.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac.sac import (
+    SACConfig,
+    SACLearner,
+    SACModule,
+    SACNet,
+    _sample_squashed,
+)
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or CQL)
+        self.cql_alpha = 1.0
+        self.num_cql_actions = 4  # OOD samples per source (uniform + policy)
+        self.num_steps_sampled_before_learning_starts = 0
+
+    def get_default_learner_class(self):
+        return CQLLearner
+
+
+class CQLLearner(SACLearner):
+    def compute_loss(self, params, batch, rng, extra=None):
+        cfg = self.config
+        rng, rng_sac, rng_uni, rng_pi = jax.random.split(rng, 4)
+        total, metrics = super().compute_loss(params, batch, rng_sac, extra)
+
+        net = self.module.net
+        module = self.module
+        obs = batch[SampleBatch.OBS]
+        data_actions = module.unscale(batch[SampleBatch.ACTIONS])
+        n = cfg.num_cql_actions
+        B = obs.shape[0]
+        act_dim = module.action_dim
+
+        # OOD action set: uniform over the action cube + fresh policy
+        # samples. The penalty trains the CRITICS only: the policy samples
+        # come from frozen params (reference CQL detaches them), else
+        # minimizing logsumexp Q(s, a_pi) would push the actor toward
+        # low-Q actions and fight the SAC actor objective.
+        uniform = jax.random.uniform(
+            rng_uni, (n, B, act_dim), minval=-1.0, maxval=1.0
+        )
+        mean, log_std = net.apply(
+            jax.lax.stop_gradient(params), obs, method=SACNet.actor
+        )
+        policy_acts = jnp.stack(
+            [
+                _sample_squashed(mean, log_std, k)[0]
+                for k in jax.random.split(rng_pi, n)
+            ]
+        )
+        ood = jnp.concatenate([uniform, policy_acts], axis=0)  # [2n, B, A]
+
+        def q_both(a):
+            return jnp.stack(net.apply(params, obs, a, method=SACNet.critic))
+
+        ood_q = jax.vmap(q_both)(ood)  # [2n, 2, B]
+        data_q = q_both(data_actions)  # [2, B]
+        # logsumexp over the action samples, per critic, per state.
+        lse = jax.scipy.special.logsumexp(
+            ood_q, axis=0
+        ) - jnp.log(ood.shape[0])
+        cql_penalty = jnp.mean(lse - data_q)
+        total = total + cfg.cql_alpha * cql_penalty
+        metrics = dict(metrics)
+        metrics["cql_penalty"] = cql_penalty
+        return total, metrics
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if not cfg.input_:
+            raise ValueError(
+                "CQL needs offline data: config.offline_data(input_=dir)"
+            )
+        if cfg.rl_module_spec is None:
+            from ray_tpu.rllib.env.env import make_env
+
+            probe = make_env(cfg.env, cfg.env_config)
+            cfg.rl_module_spec = RLModuleSpec(
+                module_class=SACModule,
+                observation_space=probe.observation_space,
+                action_space=probe.action_space,
+                model_config=dict(cfg.model),
+                seed=cfg.seed or 0,
+            )
+            probe.close()
+        super().setup(config)
+        self.reader = JsonReader(cfg.input_, seed=cfg.seed)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        train_batch = self.reader.sample_rows(cfg.train_batch_size)
+        results = dict(self.learner_group.update(train_batch))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return results

@@ -238,6 +238,9 @@ class SACLearner(Learner):
 
 class SAC(Algorithm):
     config_class = SACConfig
+    # Off-policy skeleton hook: subclasses (TD3) swap the module family
+    # while sharing setup/replay/training_step.
+    module_class = SACModule
 
     def setup(self, config: dict) -> None:
         cfg = self.algo_config
@@ -246,7 +249,7 @@ class SAC(Algorithm):
 
             probe = make_env(cfg.env, cfg.env_config)
             cfg.rl_module_spec = RLModuleSpec(
-                module_class=SACModule,
+                module_class=type(self).module_class,
                 observation_space=probe.observation_space,
                 action_space=probe.action_space,
                 model_config=dict(cfg.model),

@@ -1,0 +1,222 @@
+"""TD3: twin-delayed deterministic policy gradient (Fujimoto et al. 2018).
+
+Reference: rllib/algorithms/td3/td3.py (DDPG family). Shares SAC's
+off-policy skeleton — replay buffer, single jitted loss with subtree
+stop-gradients, polyak target networks — with TD3's three tricks:
+
+  * twin critics, target = min(Q1', Q2')  (overestimation control);
+  * target-policy smoothing: clipped Gaussian noise on the target action;
+  * delayed policy updates: a traced step counter gates the actor
+    objective inside jit (no retrace), and after_update reverts the pi
+    subtree on off-ticks so Adam momentum cannot drift it — the actor
+    genuinely moves only every `policy_delay`-th update, when the target
+    networks polyak too.
+
+Exploration: additive Gaussian noise from rllib.utils.exploration.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig, _MLP
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.exploration import GaussianNoise
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class TD3Net(nn.Module):
+    """Deterministic policy + twin critics in one param tree."""
+
+    action_dim: int
+    hiddens: tuple = (256, 256)
+
+    def setup(self):
+        self.pi = _MLP(self.action_dim, self.hiddens)
+        self.q1 = _MLP(1, self.hiddens)
+        self.q2 = _MLP(1, self.hiddens)
+
+    def __call__(self, obs):
+        dummy = jnp.zeros(obs.shape[:-1] + (self.action_dim,), obs.dtype)
+        self.actor(obs)
+        self.critic(obs, dummy)
+        return obs
+
+    def actor(self, obs):
+        return jnp.tanh(self.pi(obs))
+
+    def critic(self, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return self.q1(x)[..., 0], self.q2(x)[..., 0]
+
+
+class TD3Module(RLModule):
+    has_value_head = False
+
+    def __init__(self, observation_space, action_space, model_config=None,
+                 net=None, seed: int = 0):
+        assert isinstance(action_space, Box), "TD3 needs a continuous space"
+        model_config = dict(model_config or {})
+        self.action_dim = int(np.prod(action_space.shape))
+        if net is None:
+            net = TD3Net(
+                action_dim=self.action_dim,
+                hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
+            )
+        super().__init__(observation_space, action_space, model_config, net, seed)
+        self._low = np.asarray(action_space.low, np.float32)
+        self._high = np.asarray(action_space.high, np.float32)
+        self.exploration = GaussianNoise(
+            initial_scale=float(model_config.get("exploration_scale", 0.1)),
+            final_scale=float(model_config.get("exploration_final_scale", 0.1)),
+            scale_timesteps=int(model_config.get("exploration_timesteps", 1)),
+        )
+
+    def _scale(self, a):
+        low, high = self._low, self._high
+        return low + (a + 1.0) * 0.5 * (high - low)
+
+    def exploration_inputs(self, timestep: int) -> dict:
+        return self.exploration.inputs(timestep)
+
+    def forward_exploration(self, params, batch, rng) -> dict:
+        a = self.net.apply(params, batch[SampleBatch.OBS], method=TD3Net.actor)
+        noise = batch.get("noise_scale", 0.1) * jax.random.normal(rng, a.shape)
+        return {SampleBatch.ACTIONS: self._scale(jnp.clip(a + noise, -1, 1))}
+
+    def forward_inference(self, params, batch) -> dict:
+        a = self.net.apply(params, batch[SampleBatch.OBS], method=TD3Net.actor)
+        return {SampleBatch.ACTIONS: self._scale(a)}
+
+    def forward_train(self, params, batch) -> dict:
+        raise NotImplementedError("TD3Learner drives the nets directly")
+
+    def unscale(self, actions):
+        low, high = self._low, self._high
+        return jnp.clip(
+            (actions - low) / (high - low + 1e-9) * 2.0 - 1.0, -0.999, 0.999
+        )
+
+
+class TD3Config(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or TD3)
+        self.lr = 1e-3
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+
+    def get_default_learner_class(self):
+        return TD3Learner
+
+
+class TD3Learner(Learner):
+    def build(self) -> None:
+        super().build()
+        tau = self.config.tau
+
+        @jax.jit
+        def polyak(target, online):
+            return jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target, online
+            )
+
+        self._polyak = polyak
+        self._pi_snapshot = self._pi_subtree(self.module.params)
+
+    def initial_extra_state(self):
+        return {
+            "target": jax.tree_util.tree_map(jnp.array, self.module.params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    @staticmethod
+    def _pi_subtree(params):
+        return params["params"]["pi"]
+
+    def compute_loss(self, params, batch, rng, extra=None):
+        cfg = self.config
+        net = self.module.net
+        module = self.module
+        obs = batch[SampleBatch.OBS]
+        next_obs = batch[SampleBatch.NEXT_OBS]
+        actions = module.unscale(batch[SampleBatch.ACTIONS])
+        rewards = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+        target = extra["target"]
+
+        # Target-policy smoothing: clipped noise on the target action.
+        next_a = net.apply(target, next_obs, method=TD3Net.actor)
+        noise = jnp.clip(
+            cfg.target_noise * jax.random.normal(rng, next_a.shape),
+            -cfg.target_noise_clip,
+            cfg.target_noise_clip,
+        )
+        next_a = jnp.clip(next_a + noise, -1.0, 1.0)
+        tq1, tq2 = net.apply(target, next_obs, next_a, method=TD3Net.critic)
+        target_q = jax.lax.stop_gradient(
+            rewards + cfg.gamma * not_done * jnp.minimum(tq1, tq2)
+        )
+        q1, q2 = net.apply(params, obs, actions, method=TD3Net.critic)
+        critic_loss = jnp.mean((q1 - target_q) ** 2) + jnp.mean(
+            (q2 - target_q) ** 2
+        )
+
+        # Delayed deterministic policy gradient: critics frozen; the traced
+        # step counter masks the actor objective off between delay ticks.
+        frozen = jax.lax.stop_gradient(params)
+        a_pi = net.apply(params, obs, method=TD3Net.actor)
+        q1_pi, _ = net.apply(frozen, obs, a_pi, method=TD3Net.critic)
+        actor_gate = (extra["step"] % cfg.policy_delay == 0).astype(jnp.float32)
+        actor_loss = -jnp.mean(q1_pi) * actor_gate
+
+        total = critic_loss + actor_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "mean_q": jnp.mean(q1),
+        }
+
+    def after_update(self, batch) -> None:
+        import copy
+
+        step = int(self.extra_train_state["step"])  # post-increment of prior updates
+        params = self.module.params
+        if step % self.config.policy_delay != 0:
+            # TRUE delayed policy updates: the gated actor gradient is zero,
+            # but Adam momentum would still drift pi — revert the subtree so
+            # the actor only moves on delay ticks (reference TD3 skips the
+            # actor optimizer step; reverting is the single-optimizer form).
+            params = copy.copy(params)
+            inner = dict(params["params"])
+            inner["pi"] = self._pi_snapshot
+            params = dict(params)
+            params["params"] = inner
+            self.module.params = params
+            target = self.extra_train_state["target"]
+        else:
+            self._pi_snapshot = self._pi_subtree(params)
+            # Target polyak on the same delayed tick (reference pairs the
+            # target update with the policy update).
+            target = self._polyak(self.extra_train_state["target"], params)
+        self.extra_train_state = {
+            "target": target,
+            "step": self.extra_train_state["step"] + 1,
+        }
+
+
+class TD3(SAC):
+    """Shares SAC's off-policy skeleton (setup/replay/training_step);
+    only the module family and the learner differ."""
+
+    config_class = TD3Config
+    module_class = TD3Module
