@@ -1,0 +1,38 @@
+"""XLA compiler-option sweep on the GPT-2 step."""
+import functools, time
+import jax, jax.numpy as jnp, optax
+from ray_tpu.models import GPT, cross_entropy_loss, gpt2_125m
+
+B, S = 24, 1024
+cfg = gpt2_125m(attention_impl="flash", dtype=jnp.bfloat16)
+model = GPT(cfg)
+tx = optax.adamw(3e-4)
+key = jax.random.PRNGKey(0)
+tokens = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+params0 = jax.jit(model.init)(key, tokens)
+
+def run(name, options):
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1), compiler_options=options)
+    p = jax.tree_util.tree_map(lambda x: x + 0, params0)
+    o = jax.jit(tx.init)(p)
+    for _ in range(3):
+        p, o, loss = jstep(p, o, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        p, o, loss = jstep(p, o, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / 20
+    print(f"{name:40s} {dt*1e3:8.2f} ms  ({B*S/dt:,.0f} tok/s)", flush=True)
+
+run("baseline", None)
+run("scoped_vmem=65536", {"xla_tpu_scoped_vmem_limit_kib": "65536"})
+run("scoped_vmem=32768", {"xla_tpu_scoped_vmem_limit_kib": "32768"})

@@ -111,23 +111,47 @@ class DaemonWorker:
     def _read_loop(self) -> None:
         while True:
             try:
-                msg = self.conn.recv()
+                msg = self.conn.recv_raw()
             except Exception:
                 traceback.print_exc()
                 msg = None
             if msg is None:
                 break
-            kind, body = msg
+            kind, body_bytes = msg
             try:
-                if kind == "rpc" and body.get("method") == "get_by_id":
-                    # The one daemon-intercepted RPC: local-store fast path +
-                    # cross-node pull. Off-thread so a blocking wait-for-seal
-                    # doesn't wedge this worker's frame forwarding.
-                    self.daemon.rpc_pool.submit(self.daemon.serve_get, self, body)
+                if kind == "rpc_get":
+                    # The ONE frame the daemon inspects: get_by_id takes the
+                    # local-store fast path + cross-node pull (off-thread so
+                    # a blocking wait-for-seal doesn't wedge forwarding). A
+                    # body that fails to decode forwards as a decode error —
+                    # the head kills the worker rather than letting its
+                    # blocking rpc() hang.
+                    try:
+                        body = cloudpickle.loads(body_bytes)
+                    except Exception as exc:  # noqa: BLE001
+                        self.daemon.to_head(
+                            "wf",
+                            {
+                                "wid": self.wid,
+                                "k": "__decode_error__",
+                                "b": {"error": repr(exc)},
+                            },
+                        )
+                        continue
+                    self.daemon.rpc_pool.submit(
+                        self.daemon.serve_get, self, body
+                    )
                 elif kind == "pong":
                     pass  # local liveness only; EOF is the real signal
                 else:
-                    self.daemon.to_head("wf", {"wid": self.wid, "k": kind, "b": body})
+                    # Decode-free relay for EVERYTHING else (including rpc
+                    # put/submit bodies and __decode_error__ reports): the
+                    # head is the single decoder of worker frame bodies
+                    # (wire.py module docstring).
+                    self.daemon.to_head(
+                        "wf",
+                        {"wid": self.wid, "k": kind, "raw": body_bytes},
+                    )
             except Exception:
                 traceback.print_exc()
         self.alive = False

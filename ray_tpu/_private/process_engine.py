@@ -451,7 +451,7 @@ class ProcessWorkerHandle(WorkerChannel):
         # user's (unpicklable payload -> TaskError), a socket failure is the
         # system's (dead worker -> WorkerCrashedError, retryable).
         try:
-            payload = cloudpickle.dumps((kind, body), protocol=5)
+            payload = wire.encode_frame(kind, body)
         except Exception as exc:
             if self.actor_id is None and not self.expected_death:
                 self.engine.checkin(self)
@@ -532,7 +532,7 @@ class ProcessWorkerHandle(WorkerChannel):
                     error=body.get("error"),
                     traceback_str=body.get("tb", ""),
                 )
-        elif kind == "rpc":
+        elif kind in ("rpc", "rpc_get"):
             self.engine.rpc_pool.submit(self._handle_rpc, body)
         elif kind == "incref":
             with self._lock:

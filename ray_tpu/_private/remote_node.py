@@ -18,7 +18,9 @@ Frame protocol over the node connection (all cloudpickle frames, wire.py):
     ping {id}
   daemon -> head:
     register_node {...}           first frame (handled by accept_node)
-    wf {wid, k, b}                frame from worker wid (decoded by daemon)
+    wf {wid, k, raw|b}            frame from worker wid (raw = body bytes
+                                  forwarded undecoded; the head is the single
+                                  decoder — b only for daemon-inspected RPCs)
     wl {wid, pid, stream, lines}  worker stdout/stderr line batch
     worker_exit {wid}             a worker process died
     rpc {id, method, payload}     daemon-level RPC (locate_object)
@@ -60,7 +62,7 @@ class _MuxConn:
         self._wid = wid
 
     def send(self, kind: str, body: dict) -> None:
-        self.send_bytes(cloudpickle.dumps((kind, body), protocol=5))
+        self.send_bytes(wire.encode_frame(kind, body))
 
     def send_bytes(self, payload: bytes) -> None:
         self._node.conn.send("tw", {"wid": self._wid, "p": payload})
@@ -178,6 +180,21 @@ class NodeHandle:
                 handle = self._workers.get(body["wid"])
             if handle is None:
                 return
+            if "raw" in body:
+                # Decode-free relay: the daemon forwarded the worker's
+                # pickled body untouched; this is the single decode.
+                try:
+                    body = {
+                        "wid": body["wid"],
+                        "k": body["k"],
+                        "b": cloudpickle.loads(body["raw"]),
+                    }
+                except Exception as exc:  # noqa: BLE001
+                    body = {
+                        "wid": body["wid"],
+                        "k": "__decode_error__",
+                        "b": {"error": repr(exc)},
+                    }
             if body["k"] == "__decode_error__":
                 # The daemon couldn't unpickle this worker's frame (e.g. a
                 # return value referencing a module the node cannot import).

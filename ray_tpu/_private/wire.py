@@ -8,8 +8,14 @@ frames — same framing both directions, full duplex, strictly ordered per
 socket (ordering is load-bearing: incref frames must land before the task's
 "done", and stream items before the stream's completion).
 
-Frame = [u32 little-endian length][cloudpickle payload].
-Payload = (kind: str, body: dict).
+Frame = [u32 little-endian length][u8 kind_len][kind utf-8][pickled body].
+
+The kind rides OUTSIDE the pickle so intermediaries can route frames
+without deserializing them: a node daemon muxing worker frames to the head
+peeks the kind and forwards the body bytes verbatim — the body is pickled
+once (worker) and unpickled once (head), not four times (the reference's
+raylet similarly forwards opaque payloads; decoding at every hop was the
+round-3 scale bottleneck flagged for the 2k-node envelope).
 """
 
 from __future__ import annotations
@@ -22,6 +28,28 @@ from typing import Any, Optional
 import cloudpickle
 
 _LEN = struct.Struct("<I")
+_KLEN = struct.Struct("<B")
+
+
+def encode_frame(kind: str, body: dict) -> bytes:
+    """Serialize one frame payload (kind + pickled body)."""
+    return encode_frame_from_bytes(
+        kind, cloudpickle.dumps(body, protocol=5)
+    )
+
+
+def encode_frame_from_bytes(kind: str, body_bytes: bytes) -> bytes:
+    kind_b = kind.encode("utf-8")
+    if len(kind_b) > 255:
+        raise ValueError(f"frame kind too long: {kind!r}")
+    return _KLEN.pack(len(kind_b)) + kind_b + body_bytes
+
+
+def split_frame(payload: bytes) -> tuple[str, bytes]:
+    """Parse a frame payload into (kind, body_bytes) without unpickling."""
+    (klen,) = _KLEN.unpack_from(payload, 0)
+    kind = payload[1:1 + klen].decode("utf-8")
+    return kind, payload[1 + klen:]
 
 # Driver -> worker kinds: hello, run_task, create_actor, actor_call, kill,
 #                         rpc_reply
@@ -37,11 +65,16 @@ class Connection:
         self._recv_buf = b""
 
     def send(self, kind: str, body: dict) -> None:
-        self.send_bytes(cloudpickle.dumps((kind, body), protocol=5))
+        self.send_bytes(encode_frame(kind, body))
+
+    def send_kind_bytes(self, kind: str, body_bytes: bytes) -> None:
+        """Forward an already-pickled body under a (new) kind — the
+        decode-free relay path."""
+        self.send_bytes(encode_frame_from_bytes(kind, body_bytes))
 
     def send_bytes(self, payload: bytes) -> None:
-        """Ship an already-serialized frame (lets callers distinguish
-        serialization errors from socket errors)."""
+        """Ship an already-serialized frame payload (encode_frame output);
+        lets callers distinguish serialization errors from socket errors."""
         frame = _LEN.pack(len(payload)) + payload
         with self._send_lock:
             self._sock.sendall(frame)
@@ -55,6 +88,19 @@ class Connection:
         to skip the frame or declare the peer dead — user data never rides
         raw in frames (func/args/values are nested pre-pickled bytes), so a
         decode error here means genuine protocol corruption."""
+        raw = self.recv_raw()
+        if raw is None:
+            return None
+        kind, body_bytes = raw
+        try:
+            return kind, cloudpickle.loads(body_bytes)
+        except Exception as exc:  # noqa: BLE001 — undecodable payload
+            return ("__decode_error__", {"error": repr(exc), "kind": kind})
+
+    def recv_raw(self) -> Optional[tuple[str, bytes]]:
+        """Blocking read of one frame WITHOUT deserializing the body:
+        (kind, body_bytes), or None on EOF. Relays route on the kind and
+        forward the bytes untouched."""
         header = self._recv_exact(_LEN.size)
         if header is None:
             return None
@@ -63,9 +109,13 @@ class Connection:
         if payload is None:
             return None
         try:
-            return cloudpickle.loads(payload)
-        except Exception as exc:  # noqa: BLE001 — undecodable payload
-            return ("__decode_error__", {"error": repr(exc)})
+            return split_frame(payload)
+        except Exception:
+            # Unparseable envelope: surface as a decode error with an
+            # unloadable body so recv() reports it uniformly.
+            return ("__decode_error__", cloudpickle.dumps({
+                "error": "malformed frame envelope"
+            }))
 
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = self._recv_buf

@@ -415,7 +415,13 @@ class Worker:
             event = threading.Event()
             slot: dict = {}
             self._rpc_waiters[msg_id] = (event, slot)
-        self.conn.send("rpc", {"id": msg_id, "method": method, "payload": payload})
+        # get_by_id rides its own frame KIND: node daemons intercept it for
+        # the local-store fast path by looking at the envelope alone — every
+        # other rpc body (put values, task args) relays undecoded.
+        frame_kind = "rpc_get" if method == "get_by_id" else "rpc"
+        self.conn.send(
+            frame_kind, {"id": msg_id, "method": method, "payload": payload}
+        )
         event.wait()
         if slot.get("dead"):
             raise ConnectionError("driver connection lost")
