@@ -281,6 +281,11 @@ class Runtime:
         _RUNTIME = self
         if resources is not None:
             self.add_node(resources, is_head=True)
+        # Standard per-subsystem gauge suite (stats/metric_defs.h analog):
+        # refreshed in the background, rendered by prometheus_text().
+        from ray_tpu.util.runtime_metrics import RuntimeMetricsSampler
+
+        self._metrics_sampler = RuntimeMetricsSampler(self)
         # Web dashboard (dashboard/head.py): read-only HTTP over the state
         # sources above (reference: dashboard/head.py module autoload).
         self.dashboard = None
@@ -1393,6 +1398,9 @@ class Runtime:
 
     def shutdown(self) -> None:
         global _RUNTIME
+        if getattr(self, "_metrics_sampler", None) is not None:
+            self._metrics_sampler.stop()
+            self._metrics_sampler = None
         if getattr(self, "dashboard", None) is not None:
             self.dashboard.stop()
             self.dashboard = None

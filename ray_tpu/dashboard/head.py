@@ -174,6 +174,9 @@ class _Handler(BaseHTTPRequestHandler):
                 tracing.traces(trace_id=q.get("trace_id"), runtime=runtime)
             )
         elif path == "/metrics":
+            from ray_tpu.util.runtime_metrics import sample_runtime_metrics
+
+            sample_runtime_metrics(runtime)  # scrape-time freshness
             self._send(200, metrics.prometheus_text().encode(), "text/plain")
         else:
             self._send(404, b"not found", "text/plain")

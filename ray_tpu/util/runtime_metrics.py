@@ -1,0 +1,162 @@
+"""Per-subsystem runtime gauges — the standard metric suite.
+
+Reference: src/ray/stats/metric_defs.h:46-88 (the canonical gauge set every
+Ray process exports: scheduler/task-state counts, object store usage,
+node/actor liveness) + the dashboard's reporter agent. Here one sampler
+refreshes the suite from the runtime's state tables; `prometheus_text()`
+(util/metrics.py) renders it alongside user-defined metrics, and the
+dashboard's /metrics endpoint serves it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu.util.metrics import Gauge
+
+_GAUGES: Optional[dict] = None
+_GAUGE_LOCK = threading.Lock()
+
+
+def _gauges() -> dict:
+    global _GAUGES
+    with _GAUGE_LOCK:
+        if _GAUGES is None:
+            _GAUGES = {
+                "nodes_alive": Gauge(
+                    "ray_tpu_nodes_alive", "Alive nodes in the cluster"
+                ),
+                "nodes_dead": Gauge(
+                    "ray_tpu_nodes_dead", "Registered nodes now dead"
+                ),
+                "actors": Gauge(
+                    "ray_tpu_actors", "Actors by state", tag_keys=("state",)
+                ),
+                "tasks": Gauge(
+                    "ray_tpu_tasks", "Task events by state", tag_keys=("state",)
+                ),
+                "scheduler_queued": Gauge(
+                    "ray_tpu_scheduler_queued_tasks",
+                    "Tasks waiting in the scheduler queue",
+                ),
+                "scheduler_blocked": Gauge(
+                    "ray_tpu_scheduler_blocked_shapes",
+                    "Shape-classes parked as unplaceable",
+                ),
+                "object_store_used": Gauge(
+                    "ray_tpu_object_store_used_bytes",
+                    "In-process object store usage",
+                ),
+                "object_store_objects": Gauge(
+                    "ray_tpu_object_store_objects",
+                    "Objects tracked by the in-process store",
+                ),
+                "shm_used": Gauge(
+                    "ray_tpu_shm_store_used_bytes",
+                    "Native shared-memory store usage",
+                ),
+                "shm_objects": Gauge(
+                    "ray_tpu_shm_store_objects",
+                    "Objects in the native shared-memory store",
+                ),
+                "placement_groups": Gauge(
+                    "ray_tpu_placement_groups",
+                    "Placement groups by state",
+                    tag_keys=("state",),
+                ),
+                "resources_total": Gauge(
+                    "ray_tpu_resources_total",
+                    "Cluster resource capacity",
+                    tag_keys=("resource",),
+                ),
+                "resources_available": Gauge(
+                    "ray_tpu_resources_available",
+                    "Cluster resources currently free",
+                    tag_keys=("resource",),
+                ),
+            }
+    return _GAUGES
+
+
+def sample_runtime_metrics(runtime) -> None:
+    """Refresh the standard gauge suite from the runtime's state tables."""
+    g = _gauges()
+    controller = runtime.controller
+    nodes = list(controller.nodes.values())
+    g["nodes_alive"].set(sum(1 for n in nodes if n.alive))
+    g["nodes_dead"].set(sum(1 for n in nodes if not n.alive))
+
+    actor_counts: dict = {}
+    for record in controller.list_actors():
+        state = record.state.value
+        actor_counts[state] = actor_counts.get(state, 0) + 1
+    for state, count in actor_counts.items():
+        g["actors"].set(count, tags={"state": state})
+
+    task_counts: dict = {}
+    for ev in runtime.task_events.list_events():
+        task_counts[ev.state] = task_counts.get(ev.state, 0) + 1
+    for state, count in task_counts.items():
+        g["tasks"].set(count, tags={"state": state})
+
+    sched = runtime.scheduler
+    with sched._cond:
+        g["scheduler_queued"].set(len(sched._queue) + len(sched._in_pass))
+        g["scheduler_blocked"].set(len(sched._blocked))
+
+    store = runtime.store
+    used = getattr(store, "used_bytes", 0)
+    g["object_store_used"].set(float(used() if callable(used) else used))
+    g["object_store_objects"].set(float(len(getattr(store, "_entries", ()))))
+    native = runtime._native_store
+    if native is not None:
+        try:
+            g["shm_used"].set(float(native.used_bytes()))
+            g["shm_objects"].set(float(native.num_objects()))
+        except Exception:
+            pass
+
+    pg_counts: dict = {}
+    for record in controller.placement_groups.values():
+        state = record.state.value
+        pg_counts[state] = pg_counts.get(state, 0) + 1
+    for state, count in pg_counts.items():
+        g["placement_groups"].set(count, tags={"state": state})
+
+    total: dict = {}
+    avail: dict = {}
+    for node in nodes:
+        if not node.alive:
+            continue
+        for key, value in node.total.items():
+            total[key] = total.get(key, 0.0) + value
+        for key, value in node.available.items():
+            avail[key] = avail.get(key, 0.0) + value
+    for key, value in total.items():
+        g["resources_total"].set(value, tags={"resource": key})
+    for key, value in avail.items():
+        g["resources_available"].set(value, tags={"resource": key})
+
+
+class RuntimeMetricsSampler:
+    """Background refresher (the reporter-agent analog)."""
+
+    def __init__(self, runtime, period_s: float = 5.0):
+        self._runtime = runtime
+        self._period = period_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="runtime-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            try:
+                sample_runtime_metrics(self._runtime)
+            except Exception:
+                pass  # sampling must never hurt the runtime
+
+    def stop(self) -> None:
+        self._stop.set()

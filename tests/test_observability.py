@@ -270,3 +270,35 @@ def test_cli_job_submit_roundtrip():
     assert out.returncode == 0, out.stderr
     assert "SUCCEEDED" in out.stdout
     assert "yes" in out.stdout
+
+
+def test_standard_gauge_suite(ray_start_regular):
+    """The metric_defs.h-style per-subsystem gauges populate from runtime
+    state and render through the prometheus exposition."""
+    from ray_tpu.util.metrics import prometheus_text
+    from ray_tpu.util.runtime_metrics import sample_runtime_metrics
+    from ray_tpu._private.runtime import get_runtime
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    runtime = get_runtime()
+    sample_runtime_metrics(runtime)
+    text = prometheus_text()
+    assert "ray_tpu_nodes_alive 1" in text
+    assert 'ray_tpu_tasks{state="FINISHED"}' in text
+    assert 'ray_tpu_actors{state="ALIVE"}' in text
+    assert 'ray_tpu_resources_total{resource="CPU"} 4' in text
+    assert "ray_tpu_scheduler_queued_tasks" in text
+    assert "ray_tpu_object_store_used_bytes" in text
