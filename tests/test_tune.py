@@ -520,3 +520,45 @@ def test_pb2_gp_explore_mechanics():
     # With >=4 observations the explore step is the GP path (deterministic
     # under the seed), not plain PBT perturbation.
     assert len(sched._gp_data) >= 4
+
+
+def test_pb2_end_to_end(ray_start_regular):
+    """PB2 drives the same exploit machinery as PBT, with GP-UCB choosing
+    the continuous exploration point: the weak trial gets pulled up and its
+    explored lr stays in bounds."""
+    from ray_tpu.tune.schedulers import PB2
+
+    def train_fn(config):
+        score = 0.0
+        ckpt = session.get_checkpoint()
+        if ckpt:
+            score = ckpt.to_dict()["score"]
+        for _ in range(12):
+            score += config["lr"]
+            session.report(
+                {"score": score},
+                checkpoint=Checkpoint.from_dict({"score": score}),
+            )
+
+    pb2 = PB2(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.01, 1.0)},
+        quantile_fraction=0.5,
+        seed=1,
+    )
+    results = tune.run(
+        train_fn,
+        config={"lr": tune.grid_search([0.02, 0.8])},
+        metric="score",
+        mode="max",
+        scheduler=pb2,
+        stop={"training_iteration": 12},
+    )
+    assert len(results) == 2
+    worst = min(r.metrics["score"] for r in results)
+    assert worst > 12 * 0.02 + 1e-9  # exploitation happened
+    assert pb2._gp_data, "PB2 collected no GP observations"
+    for r in results:
+        assert 0.01 <= r.metrics["config"]["lr"] <= 1.0 if "config" in r.metrics else True
