@@ -40,6 +40,7 @@ class DQNModule(RLModule):
             net = QNet(
                 num_actions=action_space.n,
                 hiddens=tuple(model_config.get("fcnet_hiddens", (256, 256))),
+                dueling=bool(model_config.get("dueling", False)),
             )
         super().__init__(observation_space, action_space, model_config, net, seed)
         from ray_tpu.rllib.utils.exploration import EpsilonGreedy

@@ -60,18 +60,31 @@ class PiVfNet(nn.Module):
 
 
 class QNet(nn.Module):
-    """Q(s, ·) head for value-based algorithms (DQN)."""
+    """Q(s, ·) head for value-based algorithms (DQN).
+
+    dueling=True splits the torso into V(s) + A(s, ·) streams recombined as
+    Q = V + A - mean(A) (Wang et al. 2016; reference:
+    rllib dqn catalog's dueling head)."""
 
     num_actions: int
     hiddens: tuple = (256, 256)
     dtype: Any = jnp.float32
+    dueling: bool = False
 
     @nn.compact
     def __call__(self, obs):
         x = obs.reshape(obs.shape[0], -1)
         for i, width in enumerate(self.hiddens):
             x = nn.relu(nn.Dense(width, dtype=self.dtype, name=f"q_{i}")(x))
-        return nn.Dense(self.num_actions, dtype=self.dtype, name="q_head")(x)
+        if not self.dueling:
+            return nn.Dense(
+                self.num_actions, dtype=self.dtype, name="q_head"
+            )(x)
+        value = nn.Dense(1, dtype=self.dtype, name="value_head")(x)
+        adv = nn.Dense(
+            self.num_actions, dtype=self.dtype, name="advantage_head"
+        )(x)
+        return value + adv - jnp.mean(adv, axis=-1, keepdims=True)
 
 
 class RLModule:
