@@ -21,7 +21,18 @@ _GAUGE_LOCK = threading.Lock()
 
 def _gauges() -> dict:
     global _GAUGES
+    from ray_tpu.util import metrics as _metrics
+
     with _GAUGE_LOCK:
+        if _GAUGES is not None:
+            # clear_registry() (tests) may have wiped the exposition
+            # registry out from under the cache: rebuild so the suite
+            # re-registers.
+            sentinel = _GAUGES["nodes_alive"]
+            with _metrics._REGISTRY_LOCK:
+                live = _metrics._REGISTRY.get(sentinel.name) is sentinel
+            if not live:
+                _GAUGES = None
         if _GAUGES is None:
             _GAUGES = {
                 "nodes_alive": Gauge(
