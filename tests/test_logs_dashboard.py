@@ -195,3 +195,30 @@ def test_log_buffer_cursor_semantics():
         seen.extend(r["line"] for r in rows)
         after = rows[-1]["seq"]
     assert seen == [f"line-{i}" for i in range(30)]
+
+
+def test_ray_tpu_logs_cli(cluster, tmp_path):
+    """`ray-tpu logs --address=...` polls the head's log buffer over the
+    client protocol and prints attributed lines."""
+    runtime, address = cluster
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def talk():
+        print("cli-visible-line")
+        return 1
+
+    assert ray_tpu.get(talk.remote()) == 1
+    _wait_for(
+        lambda: any(
+            "cli-visible-line" in row["line"] for row in runtime.logs.tail()
+        ),
+        msg="line in buffer",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs",
+         "--address", address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "cli-visible-line" in out.stdout
+    assert "node=" in out.stdout  # attribution prefix
