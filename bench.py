@@ -330,7 +330,30 @@ def bench_resnet(on_tpu: bool) -> None:
 
 
 def main() -> None:
+    # Backend-init watchdog: a dead axon tunnel makes jax.devices() hang
+    # forever; record WHY instead of timing out silently.
+    import os as _os
+    import threading as _threading
+
+    init_done = _threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(timeout=240.0):
+            print(
+                json.dumps(
+                    {
+                        "metric": "backend_init",
+                        "error": "TPU backend init timed out after 240s "
+                        "(axon tunnel unreachable?)",
+                    }
+                ),
+                flush=True,
+            )
+            _os._exit(3)
+
+    _threading.Thread(target=_watchdog, daemon=True).start()
     on_tpu = is_tpu(jax.devices()[0])
+    init_done.set()
     for bench in (bench_gpt2, bench_ppo, bench_impala, bench_resnet):
         # The axon tunnel occasionally drops a compile stream mid-flight
         # ("response body closed before all bytes were read"); one retry
