@@ -24,7 +24,35 @@ from __future__ import annotations
 import functools
 import json
 import os
+import threading
 import time
+
+# Backend-init watchdog, armed BEFORE `import jax`: with a dead axon tunnel
+# even the import hangs (sitecustomize's plugin registration blocks on the
+# terminal), so arming it any later records nothing at all (round-4 failure
+# mode). Covers import + first jax.devices(); released in main(). Armed only
+# when run as a script — importing bench.py as a module (helpers reuse) must
+# never plant a timer that os._exit()s the host interpreter.
+_init_done = threading.Event()
+
+
+def _watchdog():
+    if not _init_done.wait(timeout=240.0):
+        print(
+            json.dumps(
+                {
+                    "metric": "backend_init",
+                    "error": "TPU backend init timed out after 240s "
+                    "(axon tunnel unreachable?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+
+if __name__ == "__main__":
+    threading.Thread(target=_watchdog, daemon=True).start()
 
 # The axon TPU plugin force-overrides JAX_PLATFORMS at import; re-apply an
 # explicitly requested CPU platform via the config knob, which wins over both.
@@ -44,6 +72,15 @@ import optax
 GPU_PARITY_IMG_S_PER_CHIP = 1500.0
 GPU_PARITY_TOK_S_PER_CHIP = 150_000.0
 PARITY_PPO_ENV_STEPS_S = 10_000.0
+
+# Metric lines queue here and main() prints them only after the bench attempt
+# succeeds, so a failed attempt's partial output is never duplicated by its
+# retry (consumers keep exactly one value per metric).
+_PENDING: list = []
+
+
+def _emit(line: dict) -> None:
+    _PENDING.append(json.dumps(line))
 
 
 def is_tpu(device) -> bool:
@@ -105,16 +142,13 @@ def bench_gpt2(on_tpu: bool) -> None:
     _sync(loss)
     dt = time.perf_counter() - t0
     tok_s_chip = B * S * timed / dt / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
-                "value": round(tok_s_chip, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(tok_s_chip / GPU_PARITY_TOK_S_PER_CHIP, 4),
-            }
-        ),
-        flush=True,
+    _emit(
+        {
+            "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+            "value": round(tok_s_chip, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(tok_s_chip / GPU_PARITY_TOK_S_PER_CHIP, 4),
+        }
     )
 
 
@@ -160,16 +194,13 @@ def bench_ppo(on_tpu: bool) -> None:
     import ray_tpu as _rt
 
     _rt.shutdown()
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_env_steps_per_sec",
-                "value": round(env_steps_s, 1),
-                "unit": "env_steps/sec",
-                "vs_baseline": round(env_steps_s / PARITY_PPO_ENV_STEPS_S, 4),
-            }
-        ),
-        flush=True,
+    _emit(
+        {
+            "metric": "ppo_env_steps_per_sec",
+            "value": round(env_steps_s, 1),
+            "unit": "env_steps/sec",
+            "vs_baseline": round(env_steps_s / PARITY_PPO_ENV_STEPS_S, 4),
+        }
     )
 
 
@@ -214,16 +245,13 @@ def bench_impala(on_tpu: bool) -> None:
     import ray_tpu as _rt
 
     _rt.shutdown()
-    print(
-        json.dumps(
-            {
-                "metric": "impala_env_steps_per_sec",
-                "value": round(env_steps_s, 1),
-                "unit": "env_steps/sec",
-                "vs_baseline": round(env_steps_s / PARITY_PPO_ENV_STEPS_S, 4),
-            }
-        ),
-        flush=True,
+    _emit(
+        {
+            "metric": "impala_env_steps_per_sec",
+            "value": round(env_steps_s, 1),
+            "unit": "env_steps/sec",
+            "vs_baseline": round(env_steps_s / PARITY_PPO_ENV_STEPS_S, 4),
+        }
     )
 
 
@@ -326,41 +354,26 @@ def bench_resnet(on_tpu: bool) -> None:
         line["train_tflops"] = round(train_tflops, 1)
         line["measured_matmul_tflops"] = round(matmul_tflops, 1)
         line["pct_of_measured_peak"] = round(100 * train_tflops / matmul_tflops, 1)
-    print(json.dumps(line), flush=True)
+    _emit(line)
 
 
 def main() -> None:
-    # Backend-init watchdog: a dead axon tunnel makes jax.devices() hang
-    # forever; record WHY instead of timing out silently.
-    import os as _os
-    import threading as _threading
-
-    init_done = _threading.Event()
-
-    def _watchdog():
-        if not init_done.wait(timeout=240.0):
-            print(
-                json.dumps(
-                    {
-                        "metric": "backend_init",
-                        "error": "TPU backend init timed out after 240s "
-                        "(axon tunnel unreachable?)",
-                    }
-                ),
-                flush=True,
-            )
-            _os._exit(3)
-
-    _threading.Thread(target=_watchdog, daemon=True).start()
     on_tpu = is_tpu(jax.devices()[0])
-    init_done.set()
+    _init_done.set()
     for bench in (bench_gpt2, bench_ppo, bench_impala, bench_resnet):
         # The axon tunnel occasionally drops a compile stream mid-flight
         # ("response body closed before all bytes were read"); one retry
-        # re-measures instead of recording a transient as a failure.
+        # re-measures instead of recording a transient as a failure. Metric
+        # lines are buffered per attempt and emitted only on success so a
+        # mid-run transient can't leave a half-emitted duplicate set in the
+        # line-oriented stream.
         for attempt in (0, 1):
+            _PENDING.clear()
             try:
                 bench(on_tpu)
+                for line in _PENDING:
+                    print(line, flush=True)
+                _PENDING.clear()
                 break
             except Exception as exc:  # one config failing must not hide the rest
                 if attempt == 0:

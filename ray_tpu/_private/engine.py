@@ -47,6 +47,36 @@ CONTEXT = WorkerContext()
 # the shared store (process-isolation shm path); _seal_returns must skip it.
 SEALED_EXTERNALLY = object()
 
+# Cancel requests for RUNNING streaming tasks (ray.cancel on a live
+# generator). A thread can't be preempted, but the stream drivers below check
+# this registry between yields, so an abandoned generator stops producing at
+# its next item instead of running to completion (reference: the proxy/router
+# cancel path on client disconnect). Process-global: both the in-process
+# engine and worker subprocesses (each its own process) consult it. Bounded
+# FIFO: an entry can outlive its task in the process that didn't run the
+# stream (driver-side marks, late frames), so age out the oldest instead of
+# growing forever; 4096 outstanding cancels is far past any real backlog.
+_stream_cancel_lock = threading.Lock()
+_stream_cancels: "dict" = {}  # task_id -> None, insertion-ordered
+_STREAM_CANCEL_CAP = 4096
+
+
+def request_stream_cancel(task_id) -> None:
+    with _stream_cancel_lock:
+        _stream_cancels[task_id] = None
+        while len(_stream_cancels) > _STREAM_CANCEL_CAP:
+            _stream_cancels.pop(next(iter(_stream_cancels)))
+
+
+def _stream_cancel_requested(task_id) -> bool:
+    with _stream_cancel_lock:
+        return task_id in _stream_cancels
+
+
+def _clear_stream_cancel(task_id) -> None:
+    with _stream_cancel_lock:
+        _stream_cancels.pop(task_id, None)
+
 
 class TaskResult:
     __slots__ = ("value", "exc", "traceback_str", "cancelled")
@@ -108,10 +138,12 @@ def _maybe_consume_stream(
     i = 0
     try:
         for item in gen:
-            # Abort between yields when the hosting actor was killed — the
-            # thread can't be interrupted, but the stream must not keep
-            # producing items for a dead actor.
-            if should_abort is not None and should_abort():
+            # Abort between yields when the hosting actor was killed or the
+            # caller cancelled the stream — the thread can't be interrupted,
+            # but the stream must not keep producing items nobody will read.
+            if (should_abort is not None and should_abort()) or (
+                _stream_cancel_requested(spec.task_id)
+            ):
                 gen.close()
                 break
             runtime.report_stream_item(spec, i, value=item)
@@ -121,6 +153,8 @@ def _maybe_consume_stream(
             spec, i, error=exc, traceback_str=traceback.format_exc()
         )
         i += 1
+    finally:
+        _clear_stream_cancel(spec.task_id)
     return TaskResult(value=i)
 
 
@@ -132,6 +166,9 @@ async def _consume_async_stream(spec: TaskSpec, agen) -> TaskResult:
     i = 0
     try:
         async for item in agen:
+            if _stream_cancel_requested(spec.task_id):
+                await agen.aclose()
+                break
             runtime.report_stream_item(spec, i, value=item)
             i += 1
     except BaseException as exc:  # noqa: BLE001
@@ -139,6 +176,8 @@ async def _consume_async_stream(spec: TaskSpec, agen) -> TaskResult:
             spec, i, error=exc, traceback_str=traceback.format_exc()
         )
         i += 1
+    finally:
+        _clear_stream_cancel(spec.task_id)
     return TaskResult(value=i)
 
 

@@ -387,8 +387,13 @@ class NodeDaemon:
             event.wait(timeout=300)
             return self.store.contains(oid)
         try:
+            # Bound the reply wait by the caller's get-timeout (+margin for
+            # the lookup itself) so a long user timeout doesn't look like a
+            # dead head and a short one isn't held 300s.
             reply = self.head_rpc(
-                "locate_object", {"oid": oid, "timeout": timeout}
+                "locate_object",
+                {"oid": oid, "timeout": timeout},
+                timeout=None if timeout is None else timeout + 30.0,
             )
             addrs = reply.get("addrs") or (
                 [reply["addr"]] if reply.get("addr") else []
@@ -445,7 +450,12 @@ class NodeDaemon:
 
     # -- head RPC (daemon-level) -------------------------------------------
 
-    def head_rpc(self, method: str, payload: dict):
+    def head_rpc(self, method: str, payload: dict, timeout: float = None):
+        """RPC to the head over the daemon connection. `timeout` bounds the
+        reply wait (default 300s). A waiter timeout is a TimeoutError — the
+        head may be healthy and the RPC just slow (locate_object waiting on
+        an unsealed object); only an actually-severed connection raises
+        ConnectionError."""
         with self._lock:
             if self._closed:
                 raise ConnectionError("head connection lost")
@@ -454,12 +464,17 @@ class NodeDaemon:
             event = threading.Event()
             slot: dict = {}
             self._rpc_waiters[msg_id] = (event, slot)
+        wait_s = 300.0 if timeout is None else timeout
         self.to_head("rpc", {"id": msg_id, "method": method, "payload": payload})
-        event.wait(timeout=300)
+        replied = event.wait(timeout=wait_s)
         with self._lock:
             self._rpc_waiters.pop(msg_id, None)
-        if slot.get("dead") or not slot:
+        if slot.get("dead"):
             raise ConnectionError("head connection lost")
+        if not replied or not slot:
+            raise TimeoutError(
+                f"head RPC {method!r} got no reply within {wait_s:.0f}s"
+            )
         if slot.get("ok"):
             return slot["result"]
         raise slot["exc"]

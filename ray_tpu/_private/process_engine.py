@@ -486,6 +486,21 @@ class ProcessWorkerHandle(WorkerChannel):
                         )
                     ),
                 )
+            return
+        if spec.streaming:
+            # A cancel may have raced dispatch: runtime.cancel() marks the
+            # driver registry and scans in_flight, but this task was not yet
+            # registered. The mark is authoritative — forward it now so the
+            # worker aborts the stream it is about to start.
+            from ray_tpu._private import engine as _engine
+
+            if _engine._stream_cancel_requested(spec.task_id):
+                try:
+                    self.conn.send(
+                        "cancel_stream", {"task_id": spec.task_id.binary()}
+                    )
+                except Exception:
+                    pass
 
     # -- reader ------------------------------------------------------------
 
@@ -879,6 +894,25 @@ class ProcessNodeEngine:
     def remove_actor(self, actor_id: ActorID) -> None:
         with self._lock:
             self._actors.pop(actor_id, None)
+
+    def request_stream_cancel(self, task_id) -> bool:
+        """Forward a running-stream cancel to the worker process hosting the
+        task (its recv thread marks the in-worker cancel registry, so the
+        generator loop aborts at its next yield even while the executor
+        thread is busy driving it)."""
+        tid = task_id.binary()
+        with self._lock:
+            workers = list(self._workers)
+        for handle in workers:
+            with handle._lock:
+                hosted = tid in handle.in_flight
+            if hosted:
+                try:
+                    handle.conn.send("cancel_stream", {"task_id": tid})
+                except Exception:
+                    pass  # dead worker: the crash path ends the stream anyway
+                return True
+        return False
 
     def shutdown(self) -> None:
         self.alive = False

@@ -400,6 +400,24 @@ class RemoteNodeEngine:
         with self._lock:
             self._actors.pop(actor_id, None)
 
+    def request_stream_cancel(self, task_id) -> bool:
+        """Relay a running-stream cancel to the daemon-hosted worker running
+        the task (frame muxed decode-free through the node connection; the
+        worker recv thread marks its in-process cancel registry)."""
+        tid = task_id.binary()
+        with self._lock:
+            workers = list(self._workers)
+        for handle in workers:
+            with handle._lock:
+                hosted = tid in handle.in_flight
+            if hosted:
+                try:
+                    handle.conn.send("cancel_stream", {"task_id": tid})
+                except Exception:
+                    pass
+                return True
+        return False
+
     def shutdown(self) -> None:
         self.alive = False
         with self._lock:

@@ -1116,6 +1116,30 @@ class Runtime:
             if record is not None:
                 self._finalize(record.spec, TaskResult(cancelled=True, exc=TaskCancelledError(task_id)))
             return True
+        # Already running: a thread can't be preempted, but a RUNNING
+        # streaming task stops at its next yield — the stream drivers check
+        # the engine-level cancel registry between items (reference: the
+        # running-generator cancel path; the stream then completes early and
+        # its completion ref seals, releasing any router slots).
+        with self._lock:
+            record = self._task_records.get(task_id)
+            engines = list(self.engines.values()) + list(
+                getattr(self, "_companions", {}).values()
+            )
+        if record is not None and record.spec.streaming:
+            from ray_tpu._private import engine as _engine
+
+            _engine.request_stream_cancel(task_id)  # in-process drivers
+            for eng in engines:  # worker subprocesses / daemon-hosted workers
+                forward = getattr(eng, "request_stream_cancel", None)
+                if forward is None:
+                    continue
+                try:
+                    if forward(task_id):
+                        break
+                except Exception:
+                    pass
+            return True
         return False
 
     # ------------------------------------------------------------- dispatch
@@ -1296,6 +1320,13 @@ class Runtime:
                 record.finalized = True
                 if spec.kind != TaskKind.ACTOR_CREATION:
                     self._task_records.pop(spec.task_id, None)
+        if spec.streaming:
+            # Drop any pending stream-cancel mark: in the driver process the
+            # stream driver's own finally runs in the WORKER, so without
+            # this the driver-side entry would linger until the cap ages it.
+            from ray_tpu._private.engine import _clear_stream_cancel
+
+            _clear_stream_cancel(spec.task_id)
         if result.cancelled or result.exc is not None:
             exc = result.exc
             self.task_events.record(

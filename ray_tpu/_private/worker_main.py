@@ -487,6 +487,12 @@ class Worker:
                     self.conn.send("pong", {"id": body.get("id")})
                 except Exception:
                     break
+            elif kind == "cancel_stream":
+                # Handled on the recv thread: the executor thread is busy
+                # driving the very generator being cancelled.
+                from ray_tpu._private.engine import request_stream_cancel
+
+                request_stream_cancel(TaskID(body["task_id"]))
             elif kind == "kill":
                 break
             else:
@@ -561,8 +567,10 @@ class Worker:
         }
         # User spans opened inside this task ride home with its result so
         # head-side traces() sees a complete tree (tracing_helper exports
-        # via the driver; here the done frame is the export channel).
-        spans = tracing._buffer.drain()
+        # via the driver; here the done frame is the export channel). Only
+        # THIS task's spans leave the buffer: with max_concurrency > 1 a
+        # concurrent task's spans must wait for their own done frame.
+        spans = tracing._buffer.drain(owner=spec.task_id.binary())
         if spans:
             body["spans"] = [s.to_dict() for s in spans]
         if result.exc is not None:

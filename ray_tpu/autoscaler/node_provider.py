@@ -109,6 +109,17 @@ class _DaemonBackedProvider(NodeProvider):
         self.runtime = runtime
         self._lock = threading.Lock()
         self._nodes: dict[str, dict] = {}  # pid -> {tags, ...}
+        # Provider-level event log (join-deadline reaps, launch failures) —
+        # surfaced by `ray-tpu status` / tests; bounded.
+        self.events: list = []
+
+    def _emit_event(self, message: str) -> None:
+        import logging
+        import time as _time
+
+        self.events.append({"time": _time.time(), "message": message})
+        del self.events[:-100]
+        logging.getLogger("ray_tpu.autoscaler").warning(message)
 
     def _head_address(self) -> str:
         addr = self.provider_config.get("address")
@@ -125,11 +136,16 @@ class _DaemonBackedProvider(NodeProvider):
     def non_terminated_nodes(self) -> List[str]:
         with self._lock:
             dead = [
-                pid for pid, info in self._nodes.items() if self._is_dead(info)
+                (pid, info)
+                for pid, info in self._nodes.items()
+                if self._is_dead(info)
             ]
-            for pid in dead:
+            for pid, _ in dead:
                 self._nodes.pop(pid, None)
-            return list(self._nodes)
+            alive = list(self._nodes)
+        for pid, info in dead:  # outside the lock: may ssh / re-lock
+            self._on_dead(pid, info)
+        return alive
 
     def node_tags(self, node_id: str) -> Dict[str, str]:
         with self._lock:
@@ -161,6 +177,10 @@ class _DaemonBackedProvider(NodeProvider):
                     node_labels["tpu-host"] = str(host)
                 info = self._launch(address, resources, node_labels, type_config)
                 info["tags"] = tags
+                info["pid"] = pid
+                import time as _time
+
+                info["launched_at"] = _time.monotonic()
                 with self._lock:
                     self._nodes[pid] = info
                 created.append(pid)
@@ -176,6 +196,9 @@ class _DaemonBackedProvider(NodeProvider):
 
     def _is_dead(self, info: dict) -> bool:
         raise NotImplementedError
+
+    def _on_dead(self, pid: str, info: dict) -> None:
+        """Cleanup after a node judged dead was dropped (called unlocked)."""
 
 
 class SubprocessNodeProvider(_DaemonBackedProvider):
@@ -275,18 +298,42 @@ class SSHNodeProvider(_DaemonBackedProvider):
         )
         return {"ip": ip, "remote_pid": out.stdout.strip()}
 
-    def _is_dead(self, info: dict) -> bool:
-        # Liveness is authoritative from the runtime (the daemon
-        # fate-shares with its TCP connection); avoid an ssh per poll.
-        return False
+    JOIN_DEADLINE_S = 120.0
 
-    def terminate_node(self, node_id: str) -> None:
+    def _is_dead(self, info: dict) -> bool:
+        # Once joined, liveness is authoritative from the runtime (the
+        # daemon fate-shares with its TCP connection); avoid an ssh per
+        # poll. Before the first join, enforce a deadline: a daemon that
+        # never connects (bad python path, firewall) must not leak its IP
+        # from the pool forever while the autoscaler counts a phantom
+        # pending node. Called with self._lock held — no locking here.
+        if info.get("joined"):
+            return False
+        if self.runtime_node_id(info["pid"]) is not None:
+            info["joined"] = True
+            return False
+        import time as _time
+
+        deadline = float(
+            self.provider_config.get("join_deadline_s", self.JOIN_DEADLINE_S)
+        )
+        return _time.monotonic() - info["launched_at"] > deadline
+
+    def _on_dead(self, pid: str, info: dict) -> None:
+        """A launch that never joined: kill the remote pid, reclaim the IP,
+        record an autoscaler event."""
+        self._remote_kill(info)
+        with self._lock:
+            self._free_ips.append(info["ip"])
+        self._emit_event(
+            f"ssh node {pid} on {info['ip']} never joined within its "
+            f"deadline; killed remote pid {info['remote_pid']} and "
+            f"reclaimed the IP"
+        )
+
+    def _remote_kill(self, info: dict) -> None:
         import subprocess
 
-        with self._lock:
-            info = self._nodes.pop(node_id, None)
-        if info is None:
-            return
         try:
             subprocess.run(
                 self._ssh_base(info["ip"])
@@ -297,6 +344,14 @@ class SSHNodeProvider(_DaemonBackedProvider):
             # Best-effort: the daemon fate-shares with its head connection,
             # so an unreachable host's daemon dies when the head drops it.
             pass
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_id, None)
+        if info is None:
+            return
+        try:
+            self._remote_kill(info)
         finally:
             with self._lock:
                 self._free_ips.append(info["ip"])

@@ -49,7 +49,11 @@ def fused_adamw(
     """AdamW with decoupled weight decay, one fused pass per leaf."""
 
     def init(params):
-        zeros = lambda p: jnp.zeros_like(p)
+        # Moments live in f32 from step 0 (apply() computes them in f32):
+        # param-dtype zeros would flip the state pytree's dtypes after the
+        # first step for bf16 params — a retrace, and an error under
+        # lax.scan / donated buffers.
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
         return FusedAdamWState(
             count=jnp.zeros((), jnp.int32),
             mu=jax.tree_util.tree_map(zeros, params),

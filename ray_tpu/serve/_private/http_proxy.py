@@ -97,6 +97,14 @@ class HTTPProxyActor:
         self._host = host
         self._port = port
         self._timeout_s = request_timeout_s
+        # Bounded: submissions briefly block on replica selection; an
+        # unbounded default executor would let a flood of requests spawn a
+        # thread each (weak spot vs the reference's uvicorn worker model).
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._submit_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="serve-submit"
+        )
         self._handles: dict[str, object] = {}
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -200,7 +208,9 @@ class HTTPProxyActor:
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout_s
             response = await asyncio.wait_for(
-                loop.run_in_executor(None, lambda: handle.remote(payload)),
+                loop.run_in_executor(
+                    self._submit_pool, lambda: handle.remote(payload)
+                ),
                 timeout=timeout_s,
             )
             result = await asyncio.wait_for(
@@ -231,6 +241,7 @@ class HTTPProxyActor:
 
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout_s
+        gen = None
         try:
             # Submission off-loop (replica selection can briefly block);
             # every item wait is deadline-bounded so a stalled generator
@@ -238,7 +249,7 @@ class HTTPProxyActor:
             stream_handle = handle.options(stream=True)
             gen = await asyncio.wait_for(
                 loop.run_in_executor(
-                    None, lambda: stream_handle.remote(payload)
+                    self._submit_pool, lambda: stream_handle.remote(payload)
                 ),
                 timeout=max(0.0, deadline - loop.time()),
             )
@@ -258,15 +269,29 @@ class HTTPProxyActor:
                     timeout=max(0.0, deadline - loop.time()),
                 )
         except asyncio.TimeoutError:
+            self._cancel_stream(gen)
             writer.write(
                 chunk(json.dumps({"error": f"timed out after {timeout_s}s"})
                       .encode() + b"\n")
             )
         except Exception as exc:
+            # Includes client disconnects surfacing from drain(): either way
+            # the consumer is gone, so stop the replica-side generator.
+            self._cancel_stream(gen)
             writer.write(
                 chunk(json.dumps({"error": str(exc)}).encode() + b"\n")
             )
         writer.write(b"0\r\n\r\n")
+
+    @staticmethod
+    def _cancel_stream(gen) -> None:
+        """Abandoned stream: cancel the replica generator so it stops
+        producing into the object store and frees its concurrency slot."""
+        if gen is not None:
+            try:
+                gen.cancel()
+            except Exception:
+                pass
 
     # -- plumbing -----------------------------------------------------------
 
@@ -297,6 +322,7 @@ class HTTPProxyActor:
             self._thread.join(timeout=5.0)
         except Exception:
             pass
+        self._submit_pool.shutdown(wait=False, cancel_futures=True)
 
 
 _proxy: Optional[HTTPProxyActor] = None

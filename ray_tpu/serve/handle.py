@@ -54,6 +54,19 @@ class DeploymentResponseGenerator:
     def __init__(self, ref_gen):
         self._gen = ref_gen
 
+    def cancel(self) -> None:
+        """Stop the replica-side generator at its next yield. Called by the
+        proxy on deadline/client-disconnect (the reference proxy cancels on
+        disconnect) so an abandoned stream doesn't keep the replica's
+        max_concurrent_queries slot pinned: the aborted stream completes,
+        its completion ref seals, and the router releases the slot."""
+        from ray_tpu import api as ray
+
+        try:
+            ray.cancel(self._gen._completion_ref)
+        except Exception:
+            pass  # runtime tearing down: the stream dies with it
+
     def __iter__(self):
         from ray_tpu import api as ray
 

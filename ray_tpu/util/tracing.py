@@ -30,6 +30,11 @@ from typing import Any, Dict, List, Optional
 _ambient: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_trace", default=None
 )
+# Task id (bytes) whose execution context this is — span ownership for the
+# worker's per-task drain (set by activate_task, never by user spans).
+_ambient_task: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_task", default=None
+)
 
 
 @dataclass(frozen=True)
@@ -54,18 +59,28 @@ def capture_context() -> Optional[tuple]:
     return ctx.as_tuple() if ctx is not None else None
 
 
-def activate_task(spec) -> contextvars.Token:
+def activate_task(spec):
     """Enter a task's trace context around its execution (the execution-side
     half of tracing_helper's _inject/_extract pair). The task's own span id
-    becomes the ambient parent for everything inside."""
+    becomes the ambient parent for everything inside. Also pins the ambient
+    task identity so spans opened here are attributed to THIS task when a
+    worker ships them home (concurrent tasks in one worker must not leak
+    spans into each other's done frames)."""
     trace_ctx = getattr(spec, "trace_ctx", None)
     trace_id = trace_ctx[0] if trace_ctx else task_span_id(spec.task_id)
-    return _ambient.set(TraceContext(trace_id, task_span_id(spec.task_id)))
+    return (
+        _ambient.set(TraceContext(trace_id, task_span_id(spec.task_id))),
+        _ambient_task.set(spec.task_id.binary()),
+    )
 
 
-def deactivate(token: contextvars.Token) -> None:
+def deactivate(token) -> None:
     try:
-        _ambient.reset(token)
+        if isinstance(token, tuple):
+            _ambient.reset(token[0])
+            _ambient_task.reset(token[1])
+        else:
+            _ambient.reset(token)
     except Exception:
         pass
 
@@ -80,6 +95,9 @@ class Span:
     end_s: Optional[float] = None
     kind: str = "user"  # "user" | "task"
     attributes: Dict[str, Any] = field(default_factory=dict)
+    # Task (id bytes) whose execution context opened this span; selects which
+    # task's done frame carries it home. None for driver-/background spans.
+    owner_task: Optional[bytes] = None
 
     def to_dict(self) -> dict:
         return {
@@ -109,9 +127,19 @@ class SpanBuffer:
             if len(self._spans) > self._capacity:
                 self._spans = self._spans[-self._capacity:]
 
-    def drain(self) -> List[Span]:
+    def drain(self, owner: Optional[bytes] = None) -> List[Span]:
+        """Pop finished spans; with `owner`, only that task's spans leave the
+        buffer (other tasks' spans await their own done frames). Ownerless
+        spans (helper threads, anything outside a task context) ride with
+        whichever done frame drains first — they match no task, and
+        stranding them here would drop them from head-side traces."""
         with self._lock:
-            out, self._spans = self._spans, []
+            if owner is None:
+                out, self._spans = self._spans, []
+                return out
+            take = lambda s: s.owner_task == owner or s.owner_task is None
+            out = [s for s in self._spans if take(s)]
+            self._spans = [s for s in self._spans if not take(s)]
             return out
 
     def snapshot(self) -> List[Span]:
@@ -138,6 +166,7 @@ def span(name: str, attributes: Optional[dict] = None):
         name=name,
         start_s=time.time(),
         attributes=dict(attributes or {}),
+        owner_task=_ambient_task.get(),
     )
     token = _ambient.set(TraceContext(trace_id, record.span_id))
     try:

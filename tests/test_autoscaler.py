@@ -381,3 +381,62 @@ def test_ssh_provider_command_shape():
     assert any(k == "autoscaler-provider-id" for k in labels)
     assert not provider._free_ips  # leased
     provider.terminate_node(created[0])
+
+
+def test_ssh_provider_join_deadline_reclaims_ip():
+    """A launched daemon that never connects is reaped after the join
+    deadline: remote pid killed, IP returned to the pool, autoscaler event
+    recorded. A node that DID join is exempt from the deadline."""
+    from ray_tpu.autoscaler.node_provider import PROVIDER_LABEL, SSHNodeProvider
+
+    class _Node:
+        def __init__(self, labels):
+            self.labels = labels
+            self.node_id = "nid"
+
+    class _Controller:
+        nodes = {}
+
+    class _FakeRuntime:
+        controller = _Controller()
+
+    kills = []
+
+    class _NoSSH(SSHNodeProvider):
+        def __init__(self):
+            super().__init__(
+                runtime=_FakeRuntime(),
+                provider_config={
+                    "worker_ips": ["10.0.0.9", "10.0.0.10"],
+                    "address": "head:1",
+                    "join_deadline_s": 0.2,
+                },
+            )
+
+        def _launch(self, address, resources, labels, type_config):
+            with self._lock:
+                ip = self._free_ips.pop(0)
+            return {"ip": ip, "remote_pid": "777", "labels": labels}
+
+        def _remote_kill(self, info):
+            kills.append(info["remote_pid"])
+
+    provider = _NoSSH()
+    created = provider.create_node("host", {"resources": {"CPU": 1}}, 2)
+    assert sorted(provider.non_terminated_nodes()) == sorted(created)
+
+    # First node "joins" (its provider label appears on a runtime node).
+    joined_pid = created[0]
+    _Controller.nodes = {"n1": _Node({PROVIDER_LABEL: joined_pid})}
+
+    time.sleep(0.3)
+    alive = provider.non_terminated_nodes()
+    assert alive == [joined_pid], alive  # unjoined one reaped
+    assert kills == ["777"]
+    with provider._lock:
+        assert len(provider._free_ips) == 1  # reclaimed
+    assert provider.events and "never joined" in provider.events[-1]["message"]
+
+    # The joined node stays exempt on later polls.
+    time.sleep(0.1)
+    assert provider.non_terminated_nodes() == [joined_pid]

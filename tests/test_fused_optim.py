@@ -42,3 +42,24 @@ def test_fused_adamw_update_api():
     np.testing.assert_allclose(
         np.asarray(new["w"]), np.asarray(p_direct["w"]), rtol=1e-6
     )
+
+
+def test_fused_adamw_bf16_state_dtype_stable():
+    """Moments are f32 from init: for bf16 params the state pytree's dtypes
+    must not change after the first apply (a flip forces a retrace and
+    errors under lax.scan / donated buffers)."""
+    params = {"w": jnp.ones((4, 4), jnp.bfloat16)}
+    opt = fused_adamw(1e-3)
+    state = opt.init(params)
+    assert state.mu["w"].dtype == jnp.float32
+    grads = {"w": jnp.full((4, 4), 0.1, jnp.bfloat16)}
+    params2, state2 = opt.apply(grads, state, params)
+    assert state2.mu["w"].dtype == state.mu["w"].dtype == jnp.float32
+    assert state2.nu["w"].dtype == state.nu["w"].dtype == jnp.float32
+    assert params2["w"].dtype == jnp.bfloat16
+
+    # The whole (params, state) carry must be scannable: identical treedef
+    # and leaf dtypes across steps.
+    s1 = jax.tree_util.tree_map(lambda a: a.dtype, state)
+    s2 = jax.tree_util.tree_map(lambda a: a.dtype, state2)
+    assert s1 == s2

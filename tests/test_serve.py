@@ -647,3 +647,37 @@ def test_per_node_proxies(serve_instance):
         )
         with urllib.request.urlopen(req, timeout=30) as resp:
             assert json.loads(resp.read())["result"] == {"v": 11}
+
+
+def test_stream_cancel_releases_replica_slot(serve_instance):
+    """Cancelling an abandoned stream stops the replica-side generator at
+    its next yield and frees the max_concurrent_queries slot (the proxy's
+    deadline/disconnect path; an infinite generator must not pin the
+    replica forever)."""
+    import time as _time
+
+    @serve.deployment(max_concurrent_queries=1)
+    class Infinite:
+        def __call__(self, x):
+            def gen():
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+                    _time.sleep(0.05)
+
+            return gen()
+
+        def ping(self):
+            return "pong"
+
+    handle = serve.run(Infinite.bind(), name="cancelapp")
+    gen = handle.options(stream=True).remote(0)
+    it = iter(gen)
+    assert next(it) == 0
+    assert next(it) == 1
+    gen.cancel()
+    # With the only slot pinned by the infinite stream this would time out;
+    # the cancel completes the stream, the completion ref seals, and the
+    # router releases the slot.
+    assert handle.ping.remote().result(timeout_s=20) == "pong"
