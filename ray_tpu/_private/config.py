@@ -66,6 +66,10 @@ class Config:
     # tables are snapshotted here and restored by the next session
     # (reference: gcs_table_storage.h + the Redis `gcs_storage` backend).
     gcs_storage_path: str = ""
+    # After restoring a snapshot, infeasible restored actors/PGs PARK this
+    # many seconds (daemons re-registering after a head restart) before the
+    # scheduler reverts to failing them fast.
+    head_restart_grace_s: float = 60.0
     # Copy (serialize/deserialize) task args even in the in-process engine so
     # mutation bugs surface in tests; direct zero-copy handoff when False.
     inproc_copy_args: bool = False

@@ -123,6 +123,8 @@ def build_snapshot(runtime) -> dict:
 
 def restore_snapshot(runtime, snapshot: dict) -> None:
     """Reconcile a fresh runtime with a previous session's snapshot."""
+    import time
+
     from ray_tpu._private.controller import (
         ActorRecord,
         PlacementGroupID,
@@ -131,6 +133,13 @@ def restore_snapshot(runtime, snapshot: dict) -> None:
     from ray_tpu._private.object_ref import ObjectRef
     from ray_tpu._private.runtime import _TaskRecord
 
+    # Daemons that survived the head crash re-register within their
+    # reconnect window; until then restored actors/PGs must PARK as
+    # infeasible rather than fail (they name resources only those nodes
+    # provide).
+    grace = getattr(runtime.config, "head_restart_grace_s", 60.0)
+    if grace > 0:
+        runtime.scheduler.infeasible_grace_until = time.monotonic() + grace
     controller = runtime.controller
     with controller._lock:
         controller._kv.update(snapshot.get("kv", {}))

@@ -152,6 +152,8 @@ class ClientHandle(WirePeer):
                     self._handle_incref(body)
                 elif kind == "decref":
                     self._handle_decref(body)
+                elif kind == "refs":
+                    self._handle_ref_deltas(body)
                 elif kind == "ping":
                     self.conn.send("pong", {"id": body.get("id")})
             except Exception:

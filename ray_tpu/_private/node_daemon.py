@@ -141,6 +141,16 @@ class DaemonWorker:
                     self.daemon.rpc_pool.submit(
                         self.daemon.serve_get, self, body
                     )
+                elif kind == "prefetch":
+                    # Fire-and-forget multi-object pull hint (worker is about
+                    # to get() these refs): start the pulls now so their
+                    # location lookups coalesce into one loc_sub frame and
+                    # the serial per-ref reads hit the local store.
+                    try:
+                        body = cloudpickle.loads(body_bytes)
+                    except Exception:
+                        continue
+                    self.daemon.prefetch(body.get("oids", ()), body.get("timeout"))
                 elif kind == "pong":
                     pass  # local liveness only; EOF is the real signal
                 else:
@@ -209,6 +219,7 @@ class NodeDaemon:
         resources: Optional[dict] = None,
         labels: Optional[dict] = None,
         object_store_memory: Optional[int] = None,
+        reconnect_window_s: Optional[float] = None,
     ):
         address, _, query = address.partition("?")
         token = ""
@@ -218,6 +229,17 @@ class NodeDaemon:
         self.token = token
         host, _, port = address.rpartition(":")
         self.head_host = host or "127.0.0.1"
+        self.head_port = int(port)
+        # Head-crash tolerance (the raylet's gcs_rpc_server_reconnect_timeout
+        # analog, reference gcs_redis_failure_detector.h): an UNEXPECTED
+        # connection loss triggers reconnect-with-backoff for this window
+        # before the daemon gives up and fate-shares. An explicit head
+        # "shutdown" frame still kills the daemon immediately.
+        if reconnect_window_s is None:
+            reconnect_window_s = float(
+                os.environ.get("RAY_TPU_RECONNECT_WINDOW_S", "30")
+            )
+        self.reconnect_window_s = reconnect_window_s
 
         # Node-local store (workers attach zero-copy; peers pull via the
         # object server). Sized like the head's default budget.
@@ -245,22 +267,58 @@ class NodeDaemon:
         self.rpc_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="daemon-rpc"
         )
-
-        sock = socket.create_connection((self.head_host, int(port)), 30.0)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        from ray_tpu._private.head_server import send_preamble
-
-        send_preamble(sock, token, role=b"N")
-        self.conn = wire.Connection(sock)
+        # Prefetch waiters BLOCK (waiting on loc_pub) — they get their own
+        # pool so a large multi-ref get can never occupy every rpc_pool
+        # thread and starve serve_get's local-store fast path for other
+        # workers on this node.
+        self.pull_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="daemon-pull"
+        )
 
         if resources is None:
             resources = {}
         resources.setdefault("CPU", float(os.cpu_count() or 1))
-        self.conn.send(
+        self._resources = resources
+        self._labels = labels or {}
+        self._connect()
+
+        self._lock = threading.Lock()
+        self.workers: dict[int, DaemonWorker] = {}
+        # In-flight cross-node pulls deduped per oid (PullManager semantics).
+        self._pulls: dict[bytes, threading.Event] = {}
+        self._rpc_counter = 0
+        self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
+        self._closed = False
+        # Batched location subscription (the reference pubsub's per-subscriber
+        # long-poll batching, pubsub/README.md, collapsed onto the persistent
+        # node connection): concurrent misses queue into one outbox the
+        # flusher drains as a single `loc_sub` frame, and the head pushes
+        # `loc_pub` batches back — in-flight head RPCs stay O(1) per daemon
+        # no matter how many objects are being pulled.
+        self._loc_lock = threading.Lock()
+        self._loc_cond = threading.Condition(self._loc_lock)
+        self._loc_waiters: dict[bytes, list] = {}
+        self._loc_outbox: list = []
+        self._loc_flusher = threading.Thread(
+            target=self._flush_loc_subs, name="loc-flusher", daemon=True
+        )
+        self._loc_flusher.start()
+
+    def _connect(self) -> None:
+        """Dial the head, register, and adopt its welcome. Used at startup
+        AND on reconnect after a head crash (the restarted head assigns a
+        fresh node_id; the daemon keeps its store/object server/process)."""
+        sock = socket.create_connection((self.head_host, self.head_port), 30.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from ray_tpu._private.head_server import send_preamble
+
+        send_preamble(sock, self.token, role=b"N")
+        conn = wire.Connection(sock)
+        conn.send(
             "register_node",
             {
-                "resources": resources,
-                "labels": labels or {},
+                "resources": dict(self._resources),
+                "labels": dict(self._labels),
                 "hostname": socket.gethostname(),
                 "pid": os.getpid(),
                 "object_addr": [
@@ -274,9 +332,11 @@ class NodeDaemon:
                 else None,
             },
         )
-        msg = self.conn.recv()
+        msg = conn.recv()
         if msg is None or msg[0] != "node_welcome":
+            conn.close()
             raise ConnectionError("head rejected node registration")
+        self.conn = conn
         self.welcome = msg[1]
         self.node_id = self.welcome["node_id"]
         # Adopt the driver's import roots: the daemon decodes every worker
@@ -286,14 +346,6 @@ class NodeDaemon:
         for path in self.welcome.get("sys_path", ()):
             if path not in sys.path:
                 sys.path.append(path)
-
-        self._lock = threading.Lock()
-        self.workers: dict[int, DaemonWorker] = {}
-        # In-flight cross-node pulls deduped per oid (PullManager semantics).
-        self._pulls: dict[bytes, threading.Event] = {}
-        self._rpc_counter = 0
-        self._rpc_waiters: dict[int, tuple[threading.Event, dict]] = {}
-        self._closed = False
 
     @staticmethod
     def _default_budget() -> int:
@@ -370,6 +422,62 @@ class NodeDaemon:
         # control connection — correct for small/local-only values).
         self.to_head("wf", {"wid": worker.wid, "k": "rpc", "b": body})
 
+    def prefetch(self, oids, timeout) -> None:
+        """Kick off pulls for every oid not already local (deduped against
+        in-flight pulls). ALL location subscriptions are registered under one
+        outbox lock before the flusher can wake, so a 200-object prefetch
+        costs ONE loc_sub frame; the fetches then run concurrently and the
+        prefetching worker's subsequent reads are local-store hits."""
+        if self.store is None:
+            return
+        work: list[bytes] = []
+        with self._lock:
+            if self._closed:
+                return
+            for oid in dict.fromkeys(oids):
+                try:
+                    if self.store.contains(oid) or oid in self._pulls:
+                        continue
+                except Exception:
+                    continue
+                self._pulls[oid] = threading.Event()
+                work.append(oid)
+        if not work:
+            return
+        waiters: dict[bytes, tuple] = {}
+        with self._loc_lock:
+            if self._closed:
+                with self._lock:
+                    for oid in work:
+                        self._pulls.pop(oid, None)
+                return
+            for oid in work:
+                event = threading.Event()
+                slot: dict = {}
+                self._loc_waiters.setdefault(oid, []).append((event, slot))
+                self._loc_outbox.append((oid, timeout))
+                waiters[oid] = (event, slot)
+            self._loc_cond.notify()
+        wait_s = 300.0 if timeout is None else timeout + 30.0
+
+        def finish(oid: bytes) -> None:
+            event, slot = waiters[oid]
+            try:
+                replied = event.wait(timeout=wait_s)
+                self._locate_unregister(oid, event)
+                if replied and slot and not slot.get("dead"):
+                    self._fetch_from(oid, slot)
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    done_event = self._pulls.pop(oid, None)
+                if done_event is not None:
+                    done_event.set()
+
+        for oid in work:
+            self.pull_pool.submit(finish, oid)
+
     def _pull_into_store(self, oid: bytes, timeout) -> bool:
         """Locate via the head, pull from a holding node's object server
         (streaming straight into a created shm allocation — pull memory is
@@ -390,63 +498,135 @@ class NodeDaemon:
             # Bound the reply wait by the caller's get-timeout (+margin for
             # the lookup itself) so a long user timeout doesn't look like a
             # dead head and a short one isn't held 300s.
-            reply = self.head_rpc(
-                "locate_object",
-                {"oid": oid, "timeout": timeout},
-                timeout=None if timeout is None else timeout + 30.0,
+            reply = self._locate(
+                oid,
+                timeout,
+                wait_s=300.0 if timeout is None else timeout + 30.0,
             )
-            addrs = reply.get("addrs") or (
-                [reply["addr"]] if reply.get("addr") else []
-            )
-            for i, addr in enumerate(addrs):
-                created = False
-
-                def create(size: int):
-                    nonlocal created
-                    view = self.store.create_raw(oid, size)
-                    created = view is not None
-                    return view
-
-                try:
-                    fetched = self.fetcher.fetch_into(
-                        (addr[0], addr[1]), oid, create
-                    )
-                except (ConnectionError, OSError):
-                    if created:
-                        self.store.abort_create(oid)
-                    continue  # holder gone/stale: try the next one
-                if fetched is None:
-                    if created:
-                        self.store.abort_create(oid)
-                    continue  # evicted there: try the next holder
-                tag, data = fetched
-                if data is None:
-                    self.store.seal_raw(oid)  # streamed into shm
-                else:
-                    if tag == TAG_PICKLE:
-                        from ray_tpu._private.native_store import (
-                            envelope_from_pickle,
-                        )
-
-                        data = envelope_from_pickle(data)
-                    self.store.put_raw(oid, data)
-                    if not self.store.contains(oid):
-                        # put_raw's idempotent-reseal rc can mask a stale
-                        # kCreated slot: never report success (or advertise
-                        # a copy) unless the object is actually readable.
-                        return False
-                try:
-                    self.to_head("object_cached", {"oid": oid})
-                except Exception:
-                    pass
-                return True
-            return False
+            return self._fetch_from(oid, reply)
         except Exception:
             return False
         finally:
             with self._lock:
                 self._pulls.pop(oid, None)
             event.set()
+
+    def _fetch_from(self, oid: bytes, reply: dict) -> bool:
+        """Fetch `oid` from the holders named in a location reply, trying
+        each in order; seal into the local store and advertise the cached
+        copy on success."""
+        addrs = reply.get("addrs") or (
+            [reply["addr"]] if reply.get("addr") else []
+        )
+        for addr in addrs:
+            created = False
+
+            def create(size: int):
+                nonlocal created
+                view = self.store.create_raw(oid, size)
+                created = view is not None
+                return view
+
+            try:
+                fetched = self.fetcher.fetch_into(
+                    (addr[0], addr[1]), oid, create
+                )
+            except (ConnectionError, OSError):
+                if created:
+                    self.store.abort_create(oid)
+                continue  # holder gone/stale: try the next one
+            if fetched is None:
+                if created:
+                    self.store.abort_create(oid)
+                continue  # evicted there: try the next holder
+            tag, data = fetched
+            if data is None:
+                self.store.seal_raw(oid)  # streamed into shm
+            else:
+                if tag == TAG_PICKLE:
+                    from ray_tpu._private.native_store import (
+                        envelope_from_pickle,
+                    )
+
+                    data = envelope_from_pickle(data)
+                self.store.put_raw(oid, data)
+                if not self.store.contains(oid):
+                    # put_raw's idempotent-reseal rc can mask a stale
+                    # kCreated slot: never report success (or advertise
+                    # a copy) unless the object is actually readable.
+                    return False
+            try:
+                self.to_head("object_cached", {"oid": oid})
+            except Exception:
+                pass
+            return True
+        return False
+
+    # -- batched location lookups ------------------------------------------
+
+    def _locate(self, oid: bytes, timeout, wait_s: float) -> dict:
+        """Owner-directed location lookup via the batched subscription
+        channel: register a waiter, queue the request for the flusher, block
+        until the head publishes this oid (or `wait_s` passes). Concurrent
+        lookups ride ONE loc_sub frame and ONE loc_pub reply regardless of
+        how many objects are in flight."""
+        event = threading.Event()
+        slot: dict = {}
+        with self._loc_lock:
+            if self._closed:
+                raise ConnectionError("head connection lost")
+            self._loc_waiters.setdefault(oid, []).append((event, slot))
+            self._loc_outbox.append((oid, timeout))
+            self._loc_cond.notify()
+        replied = event.wait(timeout=wait_s)
+        self._locate_unregister(oid, event)
+        if slot.get("dead"):
+            raise ConnectionError("head connection lost")
+        if not replied or not slot:
+            return {"missing": True}
+        return slot
+
+    def _locate_unregister(self, oid: bytes, event: threading.Event) -> None:
+        with self._loc_lock:
+            waiters = self._loc_waiters.get(oid)
+            if waiters:
+                kept = [w for w in waiters if w[0] is not event]
+                if kept:
+                    self._loc_waiters[oid] = kept
+                else:
+                    del self._loc_waiters[oid]
+
+    def _flush_loc_subs(self) -> None:
+        while True:
+            with self._loc_lock:
+                while not self._loc_outbox and not self._closed:
+                    self._loc_cond.wait()
+                if self._closed:
+                    return
+                reqs, self._loc_outbox = self._loc_outbox, []
+            try:
+                self.to_head("loc_sub", {"reqs": reqs})
+            except Exception:
+                # Head connection gone: fail THIS batch's waiters now — a
+                # lookup registered after the reconnect sweep would
+                # otherwise block its full wait ceiling on a frame that
+                # never left. The thread itself keeps serving (it must
+                # survive a reconnect).
+                for oid, _timeout in reqs:
+                    with self._loc_lock:
+                        waiters = self._loc_waiters.pop(oid, ())
+                    for event, slot in waiters:
+                        slot["dead"] = True
+                        event.set()
+                continue
+
+    def _handle_loc_pub(self, body: dict) -> None:
+        for oid, payload in body.get("results", ()):
+            with self._loc_lock:
+                waiters = self._loc_waiters.pop(oid, ())
+            for event, slot in waiters:
+                slot.update(payload)
+                event.set()
 
     # -- head RPC (daemon-level) -------------------------------------------
 
@@ -505,23 +685,84 @@ class NodeDaemon:
             except Exception:
                 traceback.print_exc()
                 msg = None
-            if msg is None:
-                break  # head died or kicked us: fate-share
-            kind, body = msg
-            if kind == "__decode_error__":
-                # Head->daemon frames carry only system types; corruption
-                # here means the control stream can't be trusted: fate-share.
-                print(
-                    f"daemon: undecodable head frame, exiting: "
-                    f"{body.get('error')}",
-                    file=sys.stderr,
-                )
+            if msg is None or msg[0] == "__decode_error__":
+                # Head died, kicked us, or the stream corrupted. A fresh
+                # connection resets the stream either way: try to rejoin
+                # within the reconnect window (head restart tolerance);
+                # past it, fate-share.
+                if msg is not None:
+                    print(
+                        f"daemon: undecodable head frame: "
+                        f"{msg[1].get('error')}",
+                        file=sys.stderr,
+                    )
+                if self._try_reconnect():
+                    continue
                 break
+            kind, body = msg
             try:
                 self._handle_frame(kind, body)
             except Exception:
                 traceback.print_exc()
         self.shutdown()
+
+    def _try_reconnect(self) -> bool:
+        """Rejoin a (re)started head after an unexpected connection loss.
+
+        The old head owned every in-flight task and object reference, so
+        local workers are killed (their results are undeliverable) and all
+        pending RPC/location waiters fail fast; the store and object server
+        survive, and the restarted head re-registers this machine as a fresh
+        node (reference: raylet re-registration after GCS restart,
+        gcs_redis_failure_detector.h)."""
+        import time as _time
+
+        if self.reconnect_window_s <= 0 or self._closed:
+            return False
+        with self._lock:
+            workers = list(self.workers.values())
+            self.workers.clear()
+            waiters = list(self._rpc_waiters.values())
+            self._rpc_waiters.clear()
+        for worker in workers:
+            worker.kill()
+        for event, slot in waiters:
+            slot["dead"] = True
+            event.set()
+        with self._loc_lock:
+            loc_waiters = [
+                w for ws in self._loc_waiters.values() for w in ws
+            ]
+            self._loc_waiters.clear()
+            self._loc_outbox.clear()
+        for event, slot in loc_waiters:
+            slot["dead"] = True
+            event.set()
+        deadline = _time.monotonic() + self.reconnect_window_s
+        delay = 0.5
+        print(
+            f"daemon: head connection lost; retrying for "
+            f"{self.reconnect_window_s:.0f}s",
+            flush=True,
+        )
+        while _time.monotonic() < deadline:
+            old = self.conn
+            try:
+                self._connect()
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                print(
+                    f"daemon: rejoined head as node {self.node_id}",
+                    flush=True,
+                )
+                return True
+            except Exception:
+                pass
+            _time.sleep(min(delay, max(0.1, deadline - _time.monotonic())))
+            delay = min(delay * 2, 5.0)
+        return False
 
     def _handle_frame(self, kind: str, body: dict) -> None:
         if kind == "tw":
@@ -545,6 +786,8 @@ class NodeDaemon:
                         self.store.delete(oid)
                     except Exception:
                         pass
+        elif kind == "loc_pub":
+            self._handle_loc_pub(body)
         elif kind == "rpc_reply":
             with self._lock:
                 waiter = self._rpc_waiters.pop(body["id"], None)
@@ -572,12 +815,23 @@ class NodeDaemon:
             self._rpc_waiters.clear()
             workers = list(self.workers.values())
             self.workers.clear()
+        with self._loc_lock:
+            loc_waiters = [
+                w for waiters in self._loc_waiters.values() for w in waiters
+            ]
+            self._loc_waiters.clear()
+            self._loc_outbox.clear()
+            self._loc_cond.notify_all()  # release the flusher thread
+        for event, slot in loc_waiters:
+            slot["dead"] = True
+            event.set()
         for event, slot in waiters:
             slot["dead"] = True
             event.set()
         for worker in workers:
             worker.kill()
         self.rpc_pool.shutdown(wait=False)
+        self.pull_pool.shutdown(wait=False)
         if self.object_server is not None:
             self.object_server.stop()
         self.fetcher.close()
@@ -608,6 +862,14 @@ def main(argv: Optional[list] = None) -> None:
     )
     parser.add_argument("--labels", default=None, help="node labels as JSON")
     parser.add_argument("--object-store-memory", type=int, default=None)
+    parser.add_argument(
+        "--reconnect-window",
+        type=float,
+        default=None,
+        help="seconds to retry joining a restarted head after an unexpected "
+        "connection loss (0 disables; default 30 or "
+        "$RAY_TPU_RECONNECT_WINDOW_S)",
+    )
     args = parser.parse_args(argv)
 
     resources = json.loads(args.resources) if args.resources else {}
@@ -624,6 +886,7 @@ def main(argv: Optional[list] = None) -> None:
         resources=resources,
         labels=labels,
         object_store_memory=args.object_store_memory,
+        reconnect_window_s=args.reconnect_window,
     )
     print(f"node daemon up: node_id={daemon.node_id} pid={os.getpid()}", flush=True)
     try:

@@ -100,6 +100,18 @@ class WirePeer:
         if n >= 1:
             self.runtime.refcount.remove_local_reference(ObjectID(raw))
 
+    def _handle_ref_deltas(self, body: dict) -> None:
+        """Merged borrow deltas from a peer's batching window ("refs" frame):
+        positive deltas are increfs, negative are decrefs — applied per oid
+        so a peer's net position stays exact with far fewer frames."""
+        for raw, delta in body.get("d", ()):
+            if delta > 0:
+                for _ in range(delta):
+                    self._handle_incref({"oid": raw})
+            else:
+                for _ in range(-delta):
+                    self._handle_decref({"oid": raw})
+
     # -- peer-initiated RPCs -----------------------------------------------
 
     def _handle_rpc(self, body: dict) -> None:
@@ -550,20 +562,13 @@ class ProcessWorkerHandle(WorkerChannel):
         elif kind in ("rpc", "rpc_get"):
             self.engine.rpc_pool.submit(self._handle_rpc, body)
         elif kind == "incref":
-            with self._lock:
-                raw = body["oid"]
-                self.borrows[raw] = self.borrows.get(raw, 0) + 1
-            self.runtime.refcount.add_local_reference(ObjectID(body["oid"]))
+            self._handle_incref(body)
         elif kind == "decref":
-            raw = body["oid"]
-            with self._lock:
-                n = self.borrows.get(raw, 0)
-                if n <= 1:
-                    self.borrows.pop(raw, None)
-                else:
-                    self.borrows[raw] = n - 1
-            if n >= 1:
-                self.runtime.refcount.remove_local_reference(ObjectID(raw))
+            self._handle_decref(body)
+        elif kind == "refs":
+            self._handle_ref_deltas(body)
+        elif kind == "prefetch":
+            pass  # daemon-level pull hint: meaningless for a head-hosted worker
         elif kind == "pong":
             import time
 

@@ -134,6 +134,25 @@ class NodeHandle:
 
         self.last_pong = _time.monotonic()
         self.engine: Optional["RemoteNodeEngine"] = None
+        # Per-kind counts of frames received from this daemon: the scale
+        # tests assert control-plane traffic budgets against these, and the
+        # dashboard surfaces them per node.
+        self.frame_counts: dict[str, int] = {}
+        # Batched location publication (head half of the daemon's loc_sub
+        # channel): seal callbacks queue oids here and one flusher drains
+        # them as a single loc_pub frame per wakeup.
+        self._pub_lock = threading.Lock()
+        self._pub_cond = threading.Condition(self._pub_lock)
+        self._pub_outbox: list = []
+        # Live subscriptions (one seal callback per oid per handle) and the
+        # deadlines at which unanswered ones publish an explicit miss.
+        self._subbed: set = set()
+        self._sub_deadlines: dict = {}
+        self._pub_thread = threading.Thread(
+            target=self._flush_loc_pubs,
+            name=f"locpub-{self.hostname}",
+            daemon=True,
+        )
         self._reader = threading.Thread(
             target=self._read_loop,
             name=f"node-{self.hostname}",
@@ -142,6 +161,7 @@ class NodeHandle:
 
     def start(self) -> None:
         self._reader.start()
+        self._pub_thread.start()
 
     def next_wid(self) -> int:
         with self._lock:
@@ -168,6 +188,7 @@ class NodeHandle:
             if msg is None:
                 break
             kind, body = msg
+            self.frame_counts[kind] = self.frame_counts.get(kind, 0) + 1
             try:
                 self._handle_frame(kind, body)
             except Exception:
@@ -235,6 +256,8 @@ class NodeHandle:
                 handle = self._workers.pop(body["wid"], None)
             if handle is not None:
                 handle._on_disconnect()
+        elif kind == "loc_sub":
+            self._handle_loc_sub(body)
         elif kind == "rpc":
             self.engine.rpc_pool.submit(self._handle_node_rpc, body)
         elif kind == "pong":
@@ -268,43 +291,159 @@ class NodeHandle:
                 pass
 
     def _dispatch_node_rpc(self, method: str, payload: dict):
-        runtime = self.runtime
         if method == "locate_object":
-            # Owner-directed location lookup: wait for the seal, then point
-            # the daemon at the object servers holding the bytes. Cached
-            # copies are listed in random order AHEAD of the producer so a
-            # 1-to-N broadcast fans out across nodes that already pulled
-            # instead of serializing on the producer (push_manager.h's
-            # chunked-broadcast scaling, collapsed onto the pull protocol).
-            import random as _random
-
+            # Single-oid compatibility path (the batched loc_sub channel is
+            # the hot path); same wait-for-seal-then-point semantics.
             oid = ObjectID(payload["oid"])
-            timeout = payload.get("timeout")
-            ready, _ = runtime.store.wait([oid], 1, timeout)
+            ready, _ = self.runtime.store.wait([oid], 1, payload.get("timeout"))
             if not ready:
                 return {"missing": True}
-            locations = runtime.store.locations_of(oid)
-            primary = runtime.store.location_of(oid)
-            addrs = []
-            cached = []
-            for node_id in locations:
-                if node_id == self.node_id:
-                    continue  # don't point a node at itself
-                peer = runtime._node_handles.get(node_id)
-                if peer is not None and peer.alive and peer.object_addr:
-                    entry = list(peer.object_addr)
-                    if node_id == primary:
-                        addrs.append(entry)
-                    else:
-                        cached.append(entry)
-            _random.shuffle(cached)
-            addrs = cached + addrs
-            if primary is None and runtime._object_server is not None:
-                addrs.append(list(runtime._object_server.address))
-            if not addrs:
-                return {"missing": True}
-            return {"addrs": addrs, "addr": addrs[0]}
+            return self._loc_payload(oid) or {"missing": True}
         raise ValueError(f"unknown node RPC {method!r}")
+
+    def _loc_payload(self, oid: ObjectID):
+        """Location answer for a SEALED object: the object servers holding
+        its bytes. Cached copies are listed in random order AHEAD of the
+        producer so a 1-to-N broadcast fans out across nodes that already
+        pulled instead of serializing on the producer (push_manager.h's
+        chunked-broadcast scaling, collapsed onto the pull protocol).
+        Returns None when the object has no pullable location."""
+        import random as _random
+
+        runtime = self.runtime
+        locations = runtime.store.locations_of(oid)
+        primary = runtime.store.location_of(oid)
+        addrs = []
+        cached = []
+        for node_id in locations:
+            if node_id == self.node_id:
+                continue  # don't point a node at itself
+            peer = runtime._node_handles.get(node_id)
+            if peer is not None and peer.alive and peer.object_addr:
+                entry = list(peer.object_addr)
+                if node_id == primary:
+                    addrs.append(entry)
+                else:
+                    cached.append(entry)
+        _random.shuffle(cached)
+        addrs = cached + addrs
+        if primary is None and runtime._object_server is not None:
+            addrs.append(list(runtime._object_server.address))
+        if not addrs:
+            return None
+        return {"addrs": addrs, "addr": addrs[0]}
+
+    def _handle_loc_sub(self, body: dict) -> None:
+        """Batched location subscription: answer sealed oids in one loc_pub
+        now; unsealed ones get a seal callback that queues the publication —
+        no blocked head thread per pending object (the pubsub long-poll
+        batching analog, reference pubsub/README.md). A request's timeout is
+        honored head-side: the flusher publishes {missing} at the deadline
+        so a timed get falls back at ~timeout, not at the daemon's padded
+        wait ceiling."""
+        import time as _time
+
+        store = self.runtime.store
+        ready: list = []
+        for req in body.get("reqs", ()):
+            if isinstance(req, (list, tuple)):
+                oid_bytes, timeout = req[0], req[1] if len(req) > 1 else None
+            else:
+                oid_bytes, timeout = req, None
+            oid = ObjectID(oid_bytes)
+            if store.contains(oid):
+                ready.append((oid_bytes, self._loc_payload(oid) or {"missing": True}))
+                continue
+            with self._pub_lock:
+                already = oid_bytes in self._subbed
+                if not already:
+                    self._subbed.add(oid_bytes)
+                if timeout is not None:
+                    deadline = _time.monotonic() + timeout
+                    prev = self._sub_deadlines.get(oid_bytes)
+                    if prev is None or deadline < prev:
+                        self._sub_deadlines[oid_bytes] = deadline
+                        self._pub_cond.notify()
+            if not already:
+                # One live callback per oid per handle: a retried get must
+                # not stack another closure on the store entry.
+                store.on_sealed(oid, self._make_seal_pub(oid_bytes, oid))
+        if ready:
+            self._queue_pubs(ready)
+
+    def _make_seal_pub(self, oid_bytes: bytes, oid: ObjectID):
+        # Weakref: a never-sealing object's callback must not pin this
+        # handle (conn, worker map, outboxes) after the node goes away.
+        import weakref
+
+        handle_ref = weakref.ref(self)
+
+        def _on_seal() -> None:
+            handle = handle_ref()
+            if handle is None or not handle.alive:
+                return
+            with handle._pub_lock:
+                was_live = oid_bytes in handle._subbed
+                handle._subbed.discard(oid_bytes)
+                handle._sub_deadlines.pop(oid_bytes, None)
+            if not was_live:
+                return  # expired (miss already published) or superseded
+            payload = (
+                handle._loc_payload(oid)
+                if handle.runtime.store.contains(oid)
+                else None
+            )
+            handle._queue_pubs([(oid_bytes, payload or {"missing": True})])
+
+        return _on_seal
+
+    def _queue_pubs(self, results: list) -> None:
+        with self._pub_lock:
+            self._pub_outbox.extend(results)
+            self._pub_cond.notify()
+
+    def _flush_loc_pubs(self) -> None:
+        import time as _time
+
+        while True:
+            with self._pub_lock:
+                while not self._pub_outbox and self.alive:
+                    wait_t = None
+                    if self._sub_deadlines:
+                        wait_t = max(
+                            0.0,
+                            min(self._sub_deadlines.values()) - _time.monotonic(),
+                        )
+                        if wait_t == 0.0:
+                            break  # a deadline already passed: sweep now
+                    self._pub_cond.wait(timeout=wait_t)
+                if not self.alive:
+                    return
+                now = _time.monotonic()
+                expired = [
+                    oid for oid, dl in self._sub_deadlines.items() if dl <= now
+                ]
+                for oid in expired:
+                    del self._sub_deadlines[oid]
+                    self._subbed.discard(oid)
+                results, self._pub_outbox = self._pub_outbox, []
+            # Timed-out subscriptions publish an explicit miss so the
+            # daemon's waiter falls back promptly (the seal callback, if the
+            # object appears later, re-checks _subbed and goes quiet).
+            store = self.runtime.store
+            for oid in expired:
+                obj = ObjectID(oid)
+                results.append(
+                    (oid, self._loc_payload(obj) or {"missing": True})
+                    if store.contains(obj)
+                    else (oid, {"missing": True})
+                )
+            if not results:
+                continue
+            try:
+                self.conn.send("loc_pub", {"results": results})
+            except Exception:
+                return  # connection gone: reader thread owns the teardown
 
     # -- death --------------------------------------------------------------
 
@@ -312,6 +451,8 @@ class NodeHandle:
         if not self.alive:
             return
         self.alive = False
+        with self._pub_lock:
+            self._pub_cond.notify_all()  # release the loc_pub flusher
         try:
             self.conn.close()
         except Exception:
@@ -320,6 +461,8 @@ class NodeHandle:
 
     def close(self) -> None:
         self.alive = False
+        with self._pub_lock:
+            self._pub_cond.notify_all()
         try:
             self.conn.send("shutdown", {})
         except Exception:

@@ -125,6 +125,12 @@ class Scheduler:
         self._spread_cursor = 0
         self._running = True
         self.fail_on_infeasible = True
+        # Until this monotonic deadline, infeasible tasks PARK instead of
+        # failing: a restarted head restores detached actors/PGs before its
+        # daemons have re-registered, and failing them in that gap would
+        # defeat the restart (reference: GCS restart grace before actor
+        # reconstruction is abandoned).
+        self.infeasible_grace_until = 0.0
         # Memory-pressure backpressure: while this returns False, no new
         # leases are handed out (the reference raylet stops dispatch while
         # its memory monitor reports pressure).
@@ -213,12 +219,26 @@ class Scheduler:
     # -- loop ---------------------------------------------------------------
 
     def _loop(self) -> None:
+        import time as _time
+
         while True:
             with self._cond:
                 while self._running and not self._queue and not (
                     self._dirty and self._blocked
                 ):
-                    self._cond.wait()
+                    wait_t = None
+                    if self._blocked and self.infeasible_grace_until:
+                        # Grace window active: nothing else may ever notify
+                        # (no nodes, no resource events), so wake AT the
+                        # deadline and run one pass to fail still-infeasible
+                        # heads — otherwise they'd park forever.
+                        left = self.infeasible_grace_until - _time.monotonic()
+                        if left <= 0:
+                            self.infeasible_grace_until = 0.0
+                            self._dirty = True
+                            break
+                        wait_t = min(left + 0.05, 5.0)
+                    self._cond.wait(timeout=wait_t)
                 if not self._running:
                     return
                 if not self.dispatch_gate():
@@ -334,7 +354,13 @@ class Scheduler:
             if not self._feasible_anywhere(request) and (
                 pg_record is None or pg_record.state == PlacementGroupState.CREATED
             ):
-                if self.fail_on_infeasible and not self._demand_listeners:
+                import time as _time
+
+                if (
+                    self.fail_on_infeasible
+                    and not self._demand_listeners
+                    and _time.monotonic() >= self.infeasible_grace_until
+                ):
                     self._fail_task(
                         pending.spec,
                         OutOfResourcesError(

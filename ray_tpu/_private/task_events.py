@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -57,6 +57,11 @@ class TaskEventBuffer:
         self._events: "OrderedDict[Any, TaskEvent]" = OrderedDict()
         self._max = max_events
         self.num_dropped = 0
+        # FIFO of task_ids that reached a terminal state: eviction pops from
+        # here in O(1) instead of scanning the whole store per insert — with
+        # >max live tasks (a 1M-task pile-up) a scan made every submission
+        # O(max_events).
+        self._finished: deque = deque()
 
     def record(
         self,
@@ -84,6 +89,8 @@ class TaskEventBuffer:
                     self._evict_one_locked()
             ev.state_times[state] = now
             ev.last_state = state
+            if state in ("FINISHED", "FAILED"):
+                self._finished.append(task_id)
             if name:
                 ev.name = name
             if kind:
@@ -107,9 +114,13 @@ class TaskEventBuffer:
 
     def _evict_one_locked(self) -> None:
         """Oldest finished/failed event first; live tasks survive until only
-        live tasks remain (then oldest-inserted goes — the store is bounded)."""
-        for task_id, ev in self._events.items():
-            if ev.last_state in ("FINISHED", "FAILED"):
+        live tasks remain (then oldest-inserted goes — the store is bounded).
+        O(1) amortized: terminal ids queue in `_finished`; stale entries
+        (already evicted, or a retry revived the task) are skipped."""
+        while self._finished:
+            task_id = self._finished.popleft()
+            ev = self._events.get(task_id)
+            if ev is not None and ev.last_state in ("FINISHED", "FAILED"):
                 del self._events[task_id]
                 self.num_dropped += 1
                 return

@@ -84,7 +84,7 @@ class _BorrowCounter:
                 else:
                     send = True
         if send:
-            self._proxy._send_quiet("incref", {"oid": object_id.binary()})
+            self._proxy.note_ref_delta(object_id.binary(), +1)
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
         send = False
@@ -96,7 +96,7 @@ class _BorrowCounter:
             else:
                 self._counts[object_id] = n - 1
         if send:
-            self._proxy._send_quiet("decref", {"oid": object_id.binary()})
+            self._proxy.note_ref_delta(object_id.binary(), -1)
 
     # The public-API surface ObjectRef construction may touch:
     def add_borrowed_reference(self, object_id: ObjectID) -> None:
@@ -177,6 +177,49 @@ class WorkerProxyRuntime:
         from concurrent.futures import ThreadPoolExecutor
 
         self._bg = ThreadPoolExecutor(max_workers=4, thread_name_prefix="wproxy-bg")
+        # Ref-count delta batching: borrow edge transitions accumulate here
+        # and ship as ONE merged "refs" frame — flushed before every done/
+        # stream frame (preserving the incref-before-done wire invariant,
+        # wire.py:8) and every 200ms for idle holders. An incref/decref pair
+        # inside one window nets to zero and sends nothing, which is the
+        # common task-arg lifecycle (the reference batches the same traffic
+        # in ReferenceCount flush timers).
+        self._ref_lock = threading.Lock()
+        self._ref_flush_lock = threading.Lock()
+        self._ref_deltas: dict[bytes, int] = {}
+        self._ref_flusher = threading.Thread(
+            target=self._ref_flush_loop, name="ref-flusher", daemon=True
+        )
+        self._ref_flusher.start()
+
+    def note_ref_delta(self, oid_bytes: bytes, delta: int) -> None:
+        with self._ref_lock:
+            n = self._ref_deltas.get(oid_bytes, 0) + delta
+            if n:
+                self._ref_deltas[oid_bytes] = n
+            else:
+                self._ref_deltas.pop(oid_bytes, None)
+
+    def flush_ref_deltas(self) -> None:
+        """Ship pending deltas NOW. The flush mutex spans drain+send so a
+        concurrent periodic flush can never land its refs frame after a
+        done frame whose sender observed an empty buffer."""
+        with self._ref_flush_lock:
+            with self._ref_lock:
+                if not self._ref_deltas:
+                    return
+                deltas, self._ref_deltas = self._ref_deltas, {}
+            self._send_quiet("refs", {"d": list(deltas.items())})
+
+    def _ref_flush_loop(self) -> None:
+        import time as _time
+
+        while not self.shutting_down:
+            _time.sleep(0.2)
+            try:
+                self.flush_ref_deltas()
+            except Exception:
+                pass
 
     # -- plumbing ----------------------------------------------------------
 
@@ -213,6 +256,15 @@ class WorkerProxyRuntime:
         return self._refs_from_reply([reply["oid"]])[0]
 
     def get(self, refs: list, timeout: Optional[float]) -> list[Any]:
+        if len(refs) > 1:
+            # Multi-ref get: hint the node daemon (fire-and-forget) so all
+            # cross-node pulls start NOW and their location lookups coalesce
+            # into one batched loc_sub frame; the serial reads below then hit
+            # the local store. Head-hosted workers ignore the frame.
+            self._send_quiet(
+                "prefetch",
+                {"oids": [r.id.binary() for r in refs], "timeout": timeout},
+            )
         return [self._get_one(ref.id, timeout) for ref in refs]
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
@@ -353,6 +405,7 @@ class WorkerProxyRuntime:
     def report_stream_item(
         self, spec: TaskSpec, index: int, value=None, error=None, traceback_str=""
     ) -> None:
+        self.flush_ref_deltas()  # increfs must precede the item that hands out refs
         body = {"task_id": spec.task_id.binary(), "index": index, "tb": traceback_str}
         if error is not None:
             wire.send_with_fallback(
@@ -560,6 +613,10 @@ class Worker:
     def _send_done(self, spec: TaskSpec, result) -> None:
         from ray_tpu.util import tracing
 
+        # Flush buffered ref deltas FIRST: the owner releases this task's
+        # arg borrows when the done frame lands, so any incref this task
+        # accumulated must be on the wire ahead of it (wire.py:8).
+        self.proxy.flush_ref_deltas()
         body = {
             "task_id": spec.task_id.binary(),
             "cancelled": result.cancelled,

@@ -1,0 +1,95 @@
+"""Head process for the head-restart test (run as a subprocess).
+
+Phase "first": serve a cluster on a FIXED port+token with GCS persistence,
+wait for the node daemon, create a detached actor pinned to it, force a
+durable snapshot, print READY, then hang until the test SIGKILLs us — a
+control-plane crash with no goodbye frames.
+
+Phase "second": a RESTARTED head on the same port+token+snapshot — the
+surviving daemon re-registers within its reconnect window, the restored
+detached actor schedules onto it, and a fresh task proves the daemon never
+restarted (reference: raylet re-registration after GCS restart,
+gcs_redis_failure_detector.h).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import ray_tpu
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--token", default="restarttok")
+    parser.add_argument("--phase", choices=["first", "second"], required=True)
+    args = parser.parse_args()
+
+    runtime = ray_tpu.init(
+        num_cpus=1,
+        _system_config={
+            "isolation": "process",
+            "gcs_storage_path": args.gcs,
+        },
+    )
+    runtime.serve_clients(port=args.port, token=args.token)
+
+    if args.phase == "first":
+        deadline = time.monotonic() + 60
+        while (
+            len(runtime.controller.alive_nodes()) < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.2)
+        assert len(runtime.controller.alive_nodes()) == 2, "daemon never joined"
+
+        @ray_tpu.remote(resources={"dnode": 0.1})
+        class Survivor:
+            def __init__(self):
+                import os
+
+                self.pid = os.getpid()
+
+            def ping(self):
+                return ("alive", self.pid)
+
+        Survivor.options(name="survivor", lifetime="detached").remote()
+        handle = ray_tpu.get_actor("survivor")
+        _, pid = ray_tpu.get(handle.ping.remote())
+        print(f"ACTOR_PID {pid}", flush=True)
+        # Force the snapshot NOW: the crash must not race the debounced flush.
+        from ray_tpu._private.gcs_storage import build_snapshot
+
+        runtime._gcs_storage.save(build_snapshot(runtime))
+        print("READY", flush=True)
+        time.sleep(600)  # the test SIGKILLs us here
+    else:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                handle = ray_tpu.get_actor("survivor")
+                state, pid = ray_tpu.get(handle.ping.remote(), timeout=10)
+                print(f"SURVIVOR {state} {pid}", flush=True)
+                break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            print("FAILED no survivor", flush=True)
+            raise SystemExit(1)
+
+        @ray_tpu.remote(resources={"dnode": 0.1})
+        def on_daemon():
+            import os
+
+            return os.getppid()
+
+        print(f"TASKPPID {ray_tpu.get(on_daemon.remote(), timeout=30)}", flush=True)
+        print("DONE", flush=True)
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
