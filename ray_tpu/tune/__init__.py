@@ -7,11 +7,14 @@ is an event loop over trial actors (tune/execution/tune_controller.py:49).
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
+    DistributeResources,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from ray_tpu.tune.search.sample import (
@@ -31,6 +34,7 @@ from ray_tpu.tune.search.searcher import (
     RandomSearch,
     Searcher,
 )
+from ray_tpu.tune.search.bohb import TuneBOHB
 from ray_tpu.tune.search.tpe import TPESearch
 from ray_tpu.tune.trainable import Trainable, with_parameters, wrap_function
 from ray_tpu.tune.tuner import TuneConfig, Tuner, run
@@ -41,7 +45,11 @@ ASHAScheduler = AsyncHyperBandScheduler
 __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
+    "DistributeResources",
+    "HyperBandForBOHB",
     "HyperBandScheduler",
+    "ResourceChangingScheduler",
+    "TuneBOHB",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "FIFOScheduler",

@@ -88,6 +88,10 @@ class TuneController:
         self._searcher.set_search_properties(metric, mode, param_space or {})
         self._scheduler = scheduler or FIFOScheduler(metric, mode)
         self._scheduler.set_search_properties(metric, mode)
+        if hasattr(self._scheduler, "set_controller"):
+            # ResourceChangingScheduler's allocation function inspects the
+            # controller (live trials, cluster headroom).
+            self._scheduler.set_controller(self)
         self.metric = metric
         self.mode = mode
         self._stop_criteria = stop or {}
@@ -171,7 +175,11 @@ class TuneController:
         return actor_cls.remote(self._trainable_cls, trial.config, trial.trial_id)
 
     def _start_trial(self, trial: Trial) -> None:
-        if self._reuse_actors and self._idle_actors:
+        # A resized trial (ResourceChangingScheduler, applied in step()'s
+        # admission path) must get a FRESH actor at the new size.
+        resized = getattr(trial, "_no_actor_reuse", False)
+        trial._no_actor_reuse = False
+        if not resized and self._reuse_actors and self._idle_actors:
             actor = self._idle_actors.pop()
             ok = ray_tpu.get(actor.reset.remote(trial.config))
             if ok:
@@ -281,6 +289,18 @@ class TuneController:
                 candidate = self._next_trial()
             if candidate is None:
                 break
+            # Apply a pending ResourceChangingScheduler resize BEFORE the
+            # admission check: admitting against the stale size could start
+            # an actor the cluster can't place and block the event loop on
+            # its restore.
+            pending_resources = getattr(
+                self._scheduler, "pending_resources", None
+            )
+            if pending_resources and candidate.trial_id in pending_resources:
+                candidate.resources = dict(
+                    pending_resources.pop(candidate.trial_id)
+                )
+                candidate._no_actor_reuse = True
             if not self._has_resources(candidate) and self._live:
                 break  # wait for a slot; if nothing live, start anyway (queue)
             self._start_trial(candidate)

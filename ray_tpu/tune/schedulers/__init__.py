@@ -385,6 +385,147 @@ class HyperBandScheduler(TrialScheduler):
             self.on_trial_complete(trial, None)
 
 
+class HyperBandForBOHB(HyperBandScheduler):
+    """HyperBand variant for BOHB (reference: tune/schedulers/hb_bohb.py).
+
+    Two changes against plain HyperBand, both serving the paired TuneBOHB
+    searcher's per-budget models:
+
+      * brackets fill SEQUENTIALLY, not round-robin — each bracket's cohort
+        then shares an initial budget, so rung observations are
+        budget-comparable when they reach the searcher;
+      * the controller's searcher coupling does the rest: every result is
+        routed to TuneBOHB.on_trial_result, which buckets scores by the
+        rung milestones this scheduler runs (same max_t/reduction_factor).
+
+    Construct both halves with the same max_t and reduction_factor.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._bracket_fill = 0  # trials assigned to the current bracket
+        self._bracket_capacity = [
+            # Successive-halving cohort size: bracket b starts eta^(rungs)
+            # trials where rungs = number of halvings to reach max_t.
+            max(
+                1,
+                int(
+                    round(
+                        self.eta
+                        ** max(
+                            0,
+                            round(
+                                math.log(self.max_t / budget)
+                                / math.log(self.eta)
+                            ),
+                        )
+                    )
+                ),
+            )
+            for budget in self._bracket_budgets
+        ]
+
+    def on_trial_add(self, trial: Trial) -> None:
+        bracket = self._next_bracket
+        self._bracket_fill += 1
+        if self._bracket_fill >= self._bracket_capacity[bracket]:
+            self._bracket_fill = 0
+            self._next_bracket = (
+                self._next_bracket + 1
+            ) % len(self._bracket_budgets)
+        self._bracket_of[trial.trial_id] = bracket
+        self._milestone_of[trial.trial_id] = self._bracket_budgets[bracket]
+        self._trials.append(trial)
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Re-pack running trials onto freed capacity
+    (reference: tune/schedulers/resource_changing_scheduler.py).
+
+    Wraps a base scheduler; after each result the
+    `resources_allocation_function(controller, trial, result, scheduler)`
+    may return a new resource request for the trial. A changed request
+    PAUSES the trial (checkpointing it); the controller applies the pending
+    request when the trial resumes, so the fresh actor is created at the
+    new size. On TPUs this is the utilization story: a finished trial frees
+    a slice and survivors grow into it.
+    """
+
+    def __init__(
+        self,
+        base_scheduler: Optional[TrialScheduler] = None,
+        resources_allocation_function=None,
+    ):
+        self.base = base_scheduler or FIFOScheduler()
+        super().__init__(self.base.metric, self.base.mode)
+        self.alloc_fn = resources_allocation_function
+        # trial_id -> resources dict, applied by the controller at resume.
+        self.pending_resources: Dict[str, dict] = {}
+        self._controller = None  # injected by the controller at run start
+
+    def set_controller(self, controller) -> None:
+        self._controller = controller
+
+    def set_search_properties(self, metric, mode) -> None:
+        super().set_search_properties(metric, mode)
+        self.base.set_search_properties(metric, mode)
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self.base.on_trial_add(trial)
+
+    def on_trial_remove(self, trial: Trial) -> None:
+        self.base.on_trial_remove(trial)
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]) -> None:
+        self.base.on_trial_complete(trial, result)
+
+    def may_resume(self, trial: Trial) -> bool:
+        return self.base.may_resume(trial)
+
+    @property
+    def pending_exploits(self):
+        # PBT bases surface their exploits through the wrapper.
+        return getattr(self.base, "pending_exploits", None)
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        decision = self.base.on_trial_result(trial, result)
+        if decision != TrialScheduler.CONTINUE or self.alloc_fn is None:
+            return decision
+        try:
+            new = self.alloc_fn(self._controller, trial, result, self)
+        except Exception:
+            return decision
+        if new and dict(new) != dict(trial.resources):
+            self.pending_resources[trial.trial_id] = dict(new)
+            return TrialScheduler.PAUSE
+        return decision
+
+
+class DistributeResources:
+    """Default allocation policy: grow each live trial's CPU/TPU request to
+    an even share of the cluster total (the reference's
+    DistributeResources). Shrinks never below the base request."""
+
+    def __init__(self, base_resources: Optional[dict] = None):
+        self.base = dict(base_resources or {"CPU": 1.0})
+
+    def __call__(self, controller, trial, result, scheduler):
+        import ray_tpu
+
+        total = ray_tpu.cluster_resources()
+        live = max(1, len(getattr(controller, "_live", {}) or {1: 1}))
+        new = dict(trial.resources)
+        for key in ("CPU", "TPU"):
+            if key not in total:
+                continue
+            base = self.base.get(key, 0.0)
+            if not base and not new.get(key):
+                continue
+            share = math.floor(total[key] / live)
+            new[key] = max(base, float(share))
+        return new
+
+
 class PB2(PopulationBasedTraining):
     """PBT with a GP-bandit explore step (reference: tune/schedulers/pb2.py,
     Parker-Holder et al. 2020). Instead of random 1.2x/0.8x perturbation,

@@ -226,28 +226,38 @@ class TPESearch(Searcher):
 
     def _suggest_tpe(self) -> dict:
         good, bad = self._split()
-        obs_good = {
-            key: [c[key] for c in good if key in c] for key in self._dims
-        }
-        obs_bad = {
-            key: [c[key] for c in bad if key in c] for key in self._dims
-        }
-        for key, dim in self._dims.items():
-            if isinstance(dim, _ContinuousDim):
-                obs_good[key] = [dim._tf(v) for v in obs_good[key]]
-                obs_bad[key] = [dim._tf(v) for v in obs_bad[key]]
+        return tpe_best_candidate(
+            self._space, self._dims, good, bad, self._n_candidates, self._rng
+        )
 
-        best_config, best_score = None, -math.inf
-        for _ in range(self._n_candidates):
-            candidate = next(
-                generate_variants(self._space, 1, self._rng.random())
-            )
-            score = 0.0
-            for key, dim in self._dims.items():
-                value = dim.sample(obs_good[key], self._rng)
-                candidate[key] = value
-                score += dim.log_density(value, obs_good[key])
-                score -= dim.log_density(value, obs_bad[key])
-            if score > best_score:
-                best_config, best_score = candidate, score
-        return best_config
+
+def tpe_best_candidate(
+    space: dict,
+    dims: Dict[str, Any],
+    good: List[dict],
+    bad: List[dict],
+    n_candidates: int,
+    rng: random.Random,
+) -> dict:
+    """The TPE proposal step shared by TPESearch and TuneBOHB: sample
+    `n_candidates` configs from the good-set kernel densities and return the
+    one maximizing the summed log-likelihood ratio l(x|good) - l(x|bad)."""
+    obs_good = {key: [c[key] for c in good if key in c] for key in dims}
+    obs_bad = {key: [c[key] for c in bad if key in c] for key in dims}
+    for key, dim in dims.items():
+        if isinstance(dim, _ContinuousDim):
+            obs_good[key] = [dim._tf(v) for v in obs_good[key]]
+            obs_bad[key] = [dim._tf(v) for v in obs_bad[key]]
+
+    best_config, best_score = None, -math.inf
+    for _ in range(n_candidates):
+        candidate = next(generate_variants(space, 1, rng.random()))
+        score = 0.0
+        for key, dim in dims.items():
+            value = dim.sample(obs_good[key], rng)
+            candidate[key] = value
+            score += dim.log_density(value, obs_good[key])
+            score -= dim.log_density(value, obs_bad[key])
+        if score > best_score:
+            best_config, best_score = candidate, score
+    return best_config

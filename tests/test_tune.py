@@ -562,3 +562,131 @@ def test_pb2_end_to_end(ray_start_regular):
     assert pb2._gp_data, "PB2 collected no GP observations"
     for r in results:
         assert 0.01 <= r.metrics["config"]["lr"] <= 1.0 if "config" in r.metrics else True
+
+
+# -- BOHB (multi-fidelity TPE) ------------------------------------------------
+
+
+def test_bohb_models_highest_informative_budget():
+    """TuneBOHB fits its TPE split on the highest rung with enough
+    observations, and its suggestions concentrate near the good region."""
+    from ray_tpu.tune.search.bohb import TuneBOHB
+
+    space = {"x": tune.uniform(-2.0, 2.0)}
+    bohb = TuneBOHB(
+        space, metric="score", mode="max", max_t=9, reduction_factor=3,
+        random_fraction=0.0, seed=0,
+    )
+    # Feed observations at budget 3 AND budget 9 — the 9-rung has too few
+    # points, so the model must come from rung 3.
+    for i in range(10):
+        tid = f"lo{i}"
+        x = -2.0 + 4.0 * i / 9.0
+        bohb._pending[tid] = {"x": x}
+        score = -abs(x - 0.7)  # optimum at 0.7
+        bohb.on_trial_result(tid, {"score": score, "training_iteration": 3})
+    bohb._pending["hi0"] = {"x": 0.0}
+    bohb.on_trial_result("hi0", {"score": 0.0, "training_iteration": 9})
+    assert bohb._model_budget() == 3
+    suggestions = [bohb._suggest_config()["x"] for _ in range(20)]
+    mean_dist = sum(abs(x - 0.7) for x in suggestions) / len(suggestions)
+    # Uniform sampling over [-2,2] averages ~1.12 from 0.7.
+    assert mean_dist < 0.75, f"model did not concentrate: {mean_dist:.2f}"
+
+
+def test_bohb_end_to_end_with_tuner(ray_start_regular):
+    """BOHB = HyperBandForBOHB brackets driving the TuneBOHB model: weak
+    trials stop at rungs, the model concentrates, the best config wins."""
+    from ray_tpu.tune.schedulers import HyperBandForBOHB
+    from ray_tpu.tune.search.bohb import TuneBOHB
+
+    def train_fn(config):
+        for _ in range(9):
+            session.report({"loss": (config["lr"] - 0.01) ** 2})
+
+    space = {"lr": tune.loguniform(1e-4, 1.0)}
+    tuner = tune.Tuner(
+        train_fn,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="loss",
+            mode="min",
+            num_samples=18,
+            search_alg=TuneBOHB(
+                space, metric="loss", mode="min", max_t=9,
+                reduction_factor=3, seed=0,
+            ),
+            scheduler=HyperBandForBOHB(
+                metric="loss", mode="min", max_t=9, reduction_factor=3,
+            ),
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 18
+    assert results.get_best_result().metrics["loss"] < 0.05
+    # Successive halving actually stopped weak trials early.
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < max(iters)
+
+
+def test_resource_changing_scheduler(ray_start_regular):
+    """A trial's resource request grows mid-run: the scheduler pauses it,
+    the controller restarts it from checkpoint at the NEW size."""
+    from ray_tpu.tune.schedulers import FIFOScheduler, ResourceChangingScheduler
+
+    def train_fn(config):
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt:
+            start = ckpt.to_dict()["i"] + 1
+        for i in range(start, 6):
+            session.report(
+                {"score": float(i), "resumed_from": start},
+                checkpoint=Checkpoint.from_dict({"i": i}),
+            )
+
+    def grow_after_two(controller, trial, result, scheduler):
+        if result.get("training_iteration", 0) >= 2:
+            return {**trial.resources, "CPU": 2.0}
+        return None
+
+    scheduler = ResourceChangingScheduler(
+        base_scheduler=FIFOScheduler(),
+        resources_allocation_function=grow_after_two,
+    )
+    tuner = tune.Tuner(
+        train_fn,
+        param_space={"lr": 0.1},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=scheduler
+        ),
+        resources_per_trial={"CPU": 1.0},
+    )
+    results = tuner.fit()
+    assert len(results) == 1
+    trial = tuner._controller.trials[0]
+    assert trial.resources["CPU"] == 2.0, "resize never applied"
+    assert results.get_best_result().metrics["score"] == 5.0
+    # The resized run RESUMED from the checkpoint, not from scratch.
+    assert results.get_best_result().metrics["resumed_from"] > 0
+    assert not scheduler.pending_resources
+
+
+def test_distribute_resources_policy():
+    """DistributeResources grows a trial's CPU request toward an even share
+    of the cluster and never shrinks below the base request."""
+    from ray_tpu.tune.schedulers import DistributeResources
+
+    class _Ctl:
+        _live = {"a": 1, "b": 1}
+
+    class _Trial:
+        resources = {"CPU": 1.0}
+
+    runtime = ray_tpu.init(num_cpus=8)
+    try:
+        policy = DistributeResources(base_resources={"CPU": 1.0})
+        new = policy(_Ctl(), _Trial(), {}, None)
+        assert new["CPU"] == 4.0  # 8 CPUs / 2 live trials
+    finally:
+        ray_tpu.shutdown()
