@@ -19,6 +19,7 @@ Endpoints:
   GET /api/task_summary      per-(name,state) counts
   GET /api/logs[?node_id=&wid=&after_seq=&limit=]   log buffer tail
   GET /api/timeline          chrome://tracing JSON of task events
+  GET /api/metrics_history[?limit=&since=]   gauge-suite timeseries ring
   GET /metrics               prometheus text exposition
 """
 
@@ -49,9 +50,32 @@ _PAGE = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Task summary</h2><table id="tasks"></table>
+<h2>History <span id="hist_legend" style="font-size:.75rem;font-weight:normal"></span></h2>
+<canvas id="hist" width="900" height="160"
+  style="background:#fff;border:1px solid #ddd;width:100%;max-width:900px"></canvas>
 <h2>Recent logs</h2><pre id="logs" class="mono"
   style="background:#fff;border:1px solid #ddd;padding:.6rem;max-height:20rem;overflow:auto"></pre>
 <script>
+const HIST_KEYS=[['tasks:RUNNING','#0a7d33'],['scheduler_queued','#c22'],
+                 ['object_store_used','#1565c0']];
+function drawHistory(samples){
+  const cv=document.getElementById('hist'),ctx=cv.getContext('2d');
+  ctx.clearRect(0,0,cv.width,cv.height);
+  if(!samples.length)return;
+  document.getElementById('hist_legend').innerHTML=HIST_KEYS.map(
+    ([k,c])=>`<span style="color:${c}">■ ${esc(k)}</span>`).join(' ');
+  for(const [key,color] of HIST_KEYS){
+    const ys=samples.map(s=>s.v[key]??0);
+    const max=Math.max(...ys,1e-9);
+    ctx.strokeStyle=color;ctx.beginPath();
+    ys.forEach((y,i)=>{
+      const px=i*(cv.width-10)/Math.max(ys.length-1,1)+5;
+      const py=cv.height-8-(y/max)*(cv.height-16);
+      i?ctx.lineTo(px,py):ctx.moveTo(px,py);
+    });
+    ctx.stroke();
+  }
+}
 async function j(u){const r=await fetch(u);return r.json()}
 function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
   .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
@@ -79,6 +103,7 @@ async function refresh(){
     const logs=await j('/api/logs?limit=200');
     document.getElementById('logs').textContent=
       logs.map(l=>`(pid=${l.pid}, node=${l.hostname}) ${l.line}`).join('\\n');
+    drawHistory(await j('/api/metrics_history?limit=720'));
   }catch(e){document.getElementById('cluster').innerHTML=
       '<span class=bad>refresh failed: '+e+'</span>'}
   setTimeout(refresh, 2000);
@@ -172,6 +197,16 @@ class _Handler(BaseHTTPRequestHandler):
 
             self._json(
                 tracing.traces(trace_id=q.get("trace_id"), runtime=runtime)
+            )
+        elif path == "/api/metrics_history":
+            sampler = getattr(runtime, "_metrics_sampler", None)
+            history = getattr(sampler, "history", None)
+            self._json(
+                history.snapshot(
+                    limit=min(limit, 720), since=float(q.get("since", 0))
+                )
+                if history is not None
+                else []
             )
         elif path == "/metrics":
             from ray_tpu.util.runtime_metrics import sample_runtime_metrics
