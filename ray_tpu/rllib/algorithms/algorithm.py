@@ -101,11 +101,15 @@ class AlgorithmConfig:
             self.observation_filter = observation_filter
         return self
 
-    def offline_data(self, *, input_=None) -> "AlgorithmConfig":
-        """Directory of .jsonl batches for offline algorithms (BC/CQL);
-        the output side (`output=`) lives in training()."""
+    def offline_data(self, *, input_=None, output=None) -> "AlgorithmConfig":
+        """Offline IO: `input_` is a directory of .jsonl batches for offline
+        algorithms (BC/CQL); `output` tees every sampled rollout to a
+        JsonWriter there (feeding off-policy estimation and later offline
+        training — reference AlgorithmConfig.offline_data)."""
         if input_ is not None:
             self.input_ = input_
+        if output is not None:
+            self.output = output
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

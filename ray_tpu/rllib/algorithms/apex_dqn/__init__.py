@@ -1,0 +1,7 @@
+from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import (
+    ApexDQN,
+    ApexDQNConfig,
+    ReplayShard,
+)
+
+__all__ = ["ApexDQN", "ApexDQNConfig", "ReplayShard"]

@@ -235,20 +235,26 @@ class DQN(Algorithm):
             )
             probe.close()
         super().setup(config)
+        self.replay_buffer = self._make_replay_buffer()
+        self._steps_since_target_sync = 0
+
+    def _make_replay_buffer(self):
+        """Local replay construction; Ape-X overrides this to None (its
+        replay lives in shard actors, so allocating a full-capacity local
+        priorities array here would be pure waste)."""
+        cfg = self.algo_config
         buf_cfg = dict(cfg.replay_buffer_config)
         buf_type = buf_cfg.pop("type", "ReplayBuffer")
         if buf_type in ("PrioritizedReplayBuffer", "prioritized"):
-            self.replay_buffer = PrioritizedReplayBuffer(
+            return PrioritizedReplayBuffer(
                 capacity=buf_cfg.get("capacity", 50_000),
                 alpha=buf_cfg.get("alpha", 0.6),
                 beta=buf_cfg.get("beta", 0.4),
                 seed=cfg.seed,
             )
-        else:
-            self.replay_buffer = ReplayBuffer(
-                capacity=buf_cfg.get("capacity", 50_000), seed=cfg.seed
-            )
-        self._steps_since_target_sync = 0
+        return ReplayBuffer(
+            capacity=buf_cfg.get("capacity", 50_000), seed=cfg.seed
+        )
 
     def training_step(self) -> dict:
         cfg = self.algo_config

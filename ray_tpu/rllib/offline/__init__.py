@@ -138,4 +138,18 @@ class JsonReader:
         return merged
 
 
-__all__ = ["JsonReader", "JsonWriter"]
+from ray_tpu.rllib.offline.estimators import (  # noqa: E402
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+    estimate_from_reader,
+)
+
+__all__ = [
+    "ImportanceSampling",
+    "JsonReader",
+    "JsonWriter",
+    "OffPolicyEstimator",
+    "WeightedImportanceSampling",
+    "estimate_from_reader",
+]

@@ -1154,3 +1154,223 @@ def test_dqn_dueling_head(ray_start_regular):
     flat = str(list(weights["params"].keys()) if "params" in weights else weights)
     assert "value_head" in flat and "advantage_head" in flat
     algo.stop()
+
+
+# -- Ape-X DQN (distributed replay) ----------------------------------------
+
+
+def test_apex_dqn_mechanics(ray_start_regular):
+    """Ape-X wiring: rollouts shard round-robin into replay actors, the
+    learner samples via the prefetch pipeline, priorities return to the
+    serving shard, training metrics flow."""
+    from ray_tpu.rllib.algorithms.apex_dqn import ApexDQNConfig
+
+    cfg = (
+        ApexDQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=2, rollout_fragment_length=8)
+        .training(
+            train_batch_size=16,
+            num_steps_sampled_before_learning_starts=32,
+            target_network_update_freq=64,
+        )
+        .debugging(seed=0)
+    )
+    cfg.num_replay_shards = 3
+    algo = cfg.build()
+    assert len(algo.replay_shards) == 3
+    for _ in range(8):
+        result = algo.train()
+    # All shards got data (round-robin ingest).
+    sizes = [ray_tpu.get(s.size.remote()) for s in algo.replay_shards]
+    assert all(size > 0 for size in sizes), sizes
+    assert "td_error_abs" in result
+    assert result["replay_shards"] == 3
+    algo.stop()
+
+
+def test_apex_sharded_replay_beats_single_shard(ray_start_regular):
+    """The structural win of sharded replay: with ingest flooding ONE
+    buffer actor, the learner's sample requests queue behind adds; spread
+    over N shards, sampling keeps flowing. Measured as learner-side sample
+    throughput under a concurrent add flood."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.apex_dqn import ReplayShard
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    def make_batch(n=64):
+        return SampleBatch(
+            {
+                SampleBatch.OBS: np.random.randn(n, 4).astype(np.float32),
+                SampleBatch.ACTIONS: np.zeros(n, np.int64),
+                SampleBatch.REWARDS: np.ones(n, np.float32),
+                SampleBatch.NEXT_OBS: np.random.randn(n, 4).astype(np.float32),
+                SampleBatch.TERMINATEDS: np.zeros(n, bool),
+            }
+        )
+
+    def measure(num_shards: int, duration_s: float = 2.5) -> int:
+        from collections import deque
+
+        actor_cls = ray_tpu.remote(ReplayShard)
+        shards = [
+            actor_cls.options(num_cpus=0).remote(60_000, 0.6, 0.4, i)
+            for i in range(num_shards)
+        ]
+        ray_tpu.get([s.add.remote(make_batch(256)) for s in shards])
+        stop = threading.Event()
+        flood_batch = make_batch(2048)  # expensive enough to queue
+
+        def flood():
+            # FIXED aggregate ingest stream, split round-robin — the Ape-X
+            # deployment shape: total rollout volume is what it is; shards
+            # divide it. Bounded in-flight window (16) for backpressure.
+            inflight: deque = deque()
+            i = 0
+            while not stop.is_set():
+                inflight.append(
+                    shards[i % num_shards].add.remote(flood_batch)
+                )
+                i += 1
+                if len(inflight) > 16:
+                    try:
+                        ray_tpu.get(inflight.popleft(), timeout=30)
+                    except Exception:
+                        return
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+        samples = 0
+        import time as _time
+
+        deadline = _time.monotonic() + duration_s
+        rr = 0
+        while _time.monotonic() < deadline:
+            batch = ray_tpu.get(
+                shards[rr % num_shards].sample.remote(32), timeout=30
+            )
+            rr += 1
+            if batch is not None:
+                samples += 1
+        stop.set()
+        flooder.join(timeout=10)
+        for s in shards:
+            ray_tpu.kill(s)
+        return samples
+
+    # Wall-clock comparison on a shared machine: retry once at a longer
+    # window before declaring the structural property violated.
+    for attempt, duration in enumerate((2.5, 6.0)):
+        single = measure(1, duration)
+        sharded = measure(3, duration)
+        if sharded > single:
+            break
+    assert sharded > single, (
+        f"sharded replay ({sharded} samples) did not beat one shard "
+        f"({single} samples) under ingest flood"
+    )
+
+
+# -- off-policy estimation --------------------------------------------------
+
+
+def _bandit_batch(n_eps, behavior_p1, rng):
+    """One-step episodes: 2 actions, reward == action, behavior picks
+    action 1 with prob behavior_p1."""
+    import numpy as np
+
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    actions = (rng.random(n_eps) < behavior_p1).astype(np.int64)
+    logp = np.where(
+        actions == 1, np.log(behavior_p1), np.log(1 - behavior_p1)
+    ).astype(np.float32)
+    return SampleBatch(
+        {
+            SampleBatch.OBS: np.zeros((n_eps, 2), np.float32),
+            SampleBatch.ACTIONS: actions,
+            SampleBatch.REWARDS: actions.astype(np.float32),
+            SampleBatch.ACTION_LOGP: logp,
+            SampleBatch.EPS_ID: np.arange(n_eps, dtype=np.int64),
+        }
+    )
+
+
+def test_off_policy_estimators_is_wis():
+    import numpy as np
+
+    from ray_tpu.rllib.offline import (
+        ImportanceSampling,
+        WeightedImportanceSampling,
+    )
+
+    rng = np.random.default_rng(0)
+    batch = _bandit_batch(4000, behavior_p1=0.5, rng=rng)
+
+    def target_logp(obs, actions):
+        # Target policy picks action 1 with prob 0.9.
+        return np.where(actions == 1, np.log(0.9), np.log(0.1))
+
+    is_est = ImportanceSampling(target_logp, gamma=1.0)
+    is_est.process(batch)
+    is_result = is_est.estimate()
+    wis_est = WeightedImportanceSampling(target_logp, gamma=1.0)
+    wis_est.process(batch)
+    wis_result = wis_est.estimate()
+
+    # Behavior value is E[a] = 0.5; target policy's true value is 0.9.
+    assert abs(is_result["v_behavior"] - 0.5) < 0.05
+    assert abs(is_result["v_target"] - 0.9) < 0.08
+    assert abs(wis_result["v_target"] - 0.9) < 0.08
+    assert is_result["v_gain"] > 1.5
+    # Same-policy sanity: ratios are 1, target == behavior exactly.
+    same = ImportanceSampling(
+        lambda obs, actions: np.where(
+            actions == 1, np.log(0.5), np.log(0.5)
+        ),
+        gamma=1.0,
+    )
+    same.process(batch)
+    s = same.estimate()
+    assert abs(s["v_target"] - s["v_behavior"]) < 1e-6
+
+
+def test_off_policy_estimation_from_logged_rollouts(ray_start_regular, tmp_path):
+    """End-to-end offline flow: an algorithm logs rollouts (config.output),
+    a reader feeds them to WIS, and the estimate evaluates a target policy
+    against the logged behavior."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.offline import (
+        JsonReader,
+        WeightedImportanceSampling,
+        estimate_from_reader,
+    )
+
+    out_dir = str(tmp_path / "logged")
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=2, rollout_fragment_length=64)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+        .offline_data(output=out_dir)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    for _ in range(2):
+        algo.train()
+    algo.stop()
+
+    reader = JsonReader(out_dir, seed=0)
+    wis = WeightedImportanceSampling(
+        lambda obs, actions: np.full(len(actions), -0.6931, np.float64),
+        gamma=0.99,
+    )
+    result = estimate_from_reader(wis, reader, num_batches=2)
+    assert result["num_episodes"] > 0
+    assert np.isfinite(result["v_target"])
+    assert np.isfinite(result["v_behavior"])
