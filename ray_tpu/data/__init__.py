@@ -35,6 +35,7 @@ from ray_tpu.data.read_api import (
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 
 __all__ = [
@@ -69,4 +70,5 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
 ]

@@ -266,6 +266,9 @@ class Dataset:
     def iter_batches(self, **kwargs) -> Iterator[Any]:
         return self.iterator().iter_batches(**kwargs)
 
+    def iter_device_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_device_batches(**kwargs)
+
     def iter_torch_batches(self, *, batch_size: int = 256, **kwargs):
         import torch
 
@@ -394,6 +397,19 @@ class Dataset:
             acc.to_pandas().to_json(p, orient="records", lines=True)
 
         return self._write(path, _w, "json")
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        """One TFRecord file per block; rows become tf.train.Example records
+        (native codec, ray_tpu/data/tfrecords.py — no TF dependency)."""
+
+        def _w(acc, p):
+            from ray_tpu.data.tfrecords import encode_example, write_records
+
+            write_records(
+                p, (encode_example(row) for row in acc.iter_rows())
+            )
+
+        return self._write(path, _w, "tfrecords")
 
     def write_numpy(self, path: str, column: str = "data") -> List[str]:
         def _w(acc, p):

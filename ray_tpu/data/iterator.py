@@ -55,6 +55,48 @@ class DataIterator:
             batches = _prefetch(batches, prefetch_batches)
         return batches
 
+    def iter_device_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        sharding=None,
+        drop_last: bool = True,
+        prefetch_batches: int = 2,
+        **kwargs,
+    ) -> Iterator[Any]:
+        """iter_batches + double-buffered host→device transfer: batch N+1's
+        `jax.device_put` is ISSUED (async, DMA in flight) before batch N is
+        yielded, so the transfer overlaps the consumer's train step — the
+        feed-the-TPU layer (reference block_batching/iter_batches.py's
+        prefetching collated iterator; SURVEY §7 hard-part 3). `sharding`
+        (a jax.sharding.Sharding) places multi-chip batches; default is the
+        first device. drop_last defaults True: fixed shapes, no XLA
+        recompile on the tail batch."""
+        import jax
+
+        def put(batch):
+            if isinstance(batch, dict):
+                return {
+                    k: jax.device_put(v, sharding) for k, v in batch.items()
+                }
+            return jax.device_put(batch, sharding)
+
+        host = self.iter_batches(
+            batch_size=batch_size,
+            batch_format="numpy",
+            drop_last=drop_last,
+            prefetch_batches=prefetch_batches,
+            **kwargs,
+        )
+        pending = None
+        for batch in host:
+            issued = put(batch)  # async: DMA starts now
+            if pending is not None:
+                yield pending
+            pending = issued
+        if pending is not None:
+            yield pending
+
     def iter_rows(self) -> Iterator[Any]:
         for block_ref, _ in self._make_stream():
             yield from BlockAccessor.for_block(ray_tpu.get(block_ref)).iter_rows()

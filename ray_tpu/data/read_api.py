@@ -83,6 +83,48 @@ def read_binary_files(
     return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
 
 
+def read_tfrecords(
+    paths,
+    *,
+    batch_rows: int = 1024,
+    verify_crc: bool = True,
+    parallelism: int = DEFAULT_PARALLELISM,
+) -> Dataset:
+    """tf.train.Example TFRecord files → column blocks (reference
+    data/datasource/tfrecords_datasource.py — but TF-free: the record
+    framing and Example wire format are decoded natively,
+    ray_tpu/data/tfrecords.py)."""
+    from ray_tpu.data.datasource import Datasource, _expand_paths
+
+    class TFRecordsDatasource(Datasource):
+        def __init__(self, paths):
+            self._paths = _expand_paths(paths)
+
+        def get_read_tasks(self, parallelism: int):
+            def make(path):
+                def read():
+                    from ray_tpu.data.tfrecords import (
+                        decode_example,
+                        examples_to_columns,
+                        read_records,
+                    )
+
+                    pending = []
+                    for payload in read_records(path, verify=verify_crc):
+                        pending.append(decode_example(payload))
+                        if len(pending) >= batch_rows:
+                            yield examples_to_columns(pending)
+                            pending = []
+                    if pending:
+                        yield examples_to_columns(pending)
+
+                return read
+
+            return [make(p) for p in self._paths]
+
+    return read_datasource(TFRecordsDatasource(paths), parallelism=parallelism)
+
+
 def read_images(
     paths,
     *,
