@@ -27,7 +27,12 @@ import cloudpickle
 _ALIGN = 64
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _LIB_SOURCES = os.path.join(_REPO_ROOT, "src")
-_LIB_PATH = os.path.join(_LIB_SOURCES, "build", "libtpustore.so")
+# Sanitizer lanes point this at libtpustore_{asan,tsan}.so (src/Makefile);
+# the interposer runtime must then be LD_PRELOADed into the host process.
+_LIB_PATH = os.environ.get(
+    "RAY_TPU_STORE_LIB",
+    os.path.join(_LIB_SOURCES, "build", "libtpustore.so"),
+)
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -127,6 +127,19 @@ def _gauges() -> dict:
     return _GAUGES
 
 
+def _set_tagged(gauge, counts: dict, tag_key: str) -> None:
+    """Set a tagged gauge from fresh counts, ZEROING series whose tag state
+    vanished from the counts — without this, a state that empties (e.g.
+    tasks:RUNNING after the last task finishes) freezes at its final
+    nonzero value in every later sample and the history chart lies."""
+    for tags, _old in gauge._series().items():
+        value = dict(tags).get(tag_key)
+        if value is not None and value not in counts:
+            gauge.set(0.0, tags={tag_key: value})
+    for state, count in counts.items():
+        gauge.set(count, tags={tag_key: state})
+
+
 def sample_runtime_metrics(runtime) -> None:
     """Refresh the standard gauge suite from the runtime's state tables."""
     g = _gauges()
@@ -139,14 +152,12 @@ def sample_runtime_metrics(runtime) -> None:
     for record in controller.list_actors():
         state = record.state.value
         actor_counts[state] = actor_counts.get(state, 0) + 1
-    for state, count in actor_counts.items():
-        g["actors"].set(count, tags={"state": state})
+    _set_tagged(g["actors"], actor_counts, "state")
 
     task_counts: dict = {}
     for ev in runtime.task_events.list_events():
         task_counts[ev.state] = task_counts.get(ev.state, 0) + 1
-    for state, count in task_counts.items():
-        g["tasks"].set(count, tags={"state": state})
+    _set_tagged(g["tasks"], task_counts, "state")
 
     sched = runtime.scheduler
     with sched._cond:
@@ -169,8 +180,7 @@ def sample_runtime_metrics(runtime) -> None:
     for record in controller.placement_groups.values():
         state = record.state.value
         pg_counts[state] = pg_counts.get(state, 0) + 1
-    for state, count in pg_counts.items():
-        g["placement_groups"].set(count, tags={"state": state})
+    _set_tagged(g["placement_groups"], pg_counts, "state")
 
     total: dict = {}
     avail: dict = {}

@@ -8,6 +8,10 @@ driver continues where it left off.
 """
 
 from ray_tpu.workflow.api import (
+    Continuation,
+    EventListener,
+    TimerListener,
+    continuation,
     delete,
     get_metadata,
     get_output,
@@ -17,9 +21,15 @@ from ray_tpu.workflow.api import (
     resume,
     run,
     run_async,
+    sleep,
+    wait_for_event,
 )
 
 __all__ = [
+    "Continuation",
+    "EventListener",
+    "TimerListener",
+    "continuation",
     "delete",
     "get_metadata",
     "get_output",
@@ -29,4 +39,6 @@ __all__ = [
     "resume",
     "run",
     "run_async",
+    "sleep",
+    "wait_for_event",
 ]

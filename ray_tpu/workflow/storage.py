@@ -89,6 +89,28 @@ class WorkflowStorage:
             raise KeyError(step_id)
         return cloudpickle.loads(data)
 
+    # -- continuations ---------------------------------------------------
+
+    def save_continuation(self, step_id: str, dag: Any) -> None:
+        """Persist the sub-DAG a step returned (workflow.continuation) so a
+        crash mid-continuation resumes INTO it instead of re-running the
+        producing step (reference: dynamic workflow checkpointing)."""
+        self._write(
+            os.path.join("continuations", f"{step_id}.pkl"),
+            cloudpickle.dumps(dag),
+        )
+
+    def has_continuation(self, step_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self.root, "continuations", f"{step_id}.pkl")
+        )
+
+    def load_continuation(self, step_id: str) -> Any:
+        data = self._read(os.path.join("continuations", f"{step_id}.pkl"))
+        if data is None:
+            raise KeyError(step_id)
+        return cloudpickle.loads(data)
+
     # -- status / metadata ---------------------------------------------
 
     def save_status(self, status: str) -> None:

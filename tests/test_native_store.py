@@ -357,3 +357,79 @@ def test_kill9_under_load_rebuild(store, tmp_path):
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=10)
+
+
+@pytest.mark.parametrize("lane", ["asan", "tsan"])
+def test_sanitizer_lane_smoke(lane, tmp_path):
+    """The sanitizer builds of tpu_store.cc (reference: .bazelrc asan/tsan
+    configs) load and survive a concurrent put/get/delete exercise with the
+    sanitizer runtime interposed. The full suite runs under each lane via
+    RAY_TPU_STORE_LIB (src/Makefile header); this smoke keeps the lanes
+    from bit-rotting in the default run."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = os.path.join(repo, "src", "build", f"libtpustore_{lane}.so")
+    build = subprocess.run(
+        ["make", "-C", os.path.join(repo, "src"), lane],
+        capture_output=True,
+        timeout=120,
+    )
+    assert build.returncode == 0, build.stderr.decode()[-500:]
+    runtime_name = {"asan": "libasan.so", "tsan": "libtsan.so"}[lane]
+    runtime_lib = subprocess.run(
+        ["g++", f"-print-file-name={runtime_name}"],
+        capture_output=True,
+        text=True,
+    ).stdout.strip()
+    if "/" not in runtime_lib:
+        pytest.skip(f"{runtime_name} not installed")
+
+    script = r"""
+import os, threading
+import numpy as np
+from ray_tpu._private import native_store
+
+store = native_store.NativeStore(f"/san_smoke_{os.getpid()}", capacity=64 << 20)
+errors = []
+
+def worker(seed):
+    try:
+        rng = np.random.default_rng(seed)
+        for i in range(40):
+            oid = bytes([seed]) * 28
+            data = rng.integers(0, 255, size=4096, dtype=np.uint8).tobytes()
+            store.put_raw(oid, native_store.envelope_from_pickle(data))
+            view = store.get_raw(oid)
+            if view is not None:
+                store.release(oid)
+            store.delete(oid)
+    except Exception as e:  # noqa: BLE001
+        errors.append(e)
+
+threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+store.destroy()
+assert not errors, errors
+print("SAN_SMOKE_OK")
+"""
+    env = dict(
+        os.environ,
+        RAY_TPU_STORE_LIB=lib,
+        LD_PRELOAD=runtime_lib,
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        ASAN_OPTIONS="detect_leaks=0",
+        TSAN_OPTIONS="report_bugs=1 exitcode=66",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-800:]
+    assert "SAN_SMOKE_OK" in proc.stdout
