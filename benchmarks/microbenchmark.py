@@ -294,6 +294,84 @@ def bench_train_ingestion():
     report("train_ingestion_overlap_gain", on / off, unit="x")
 
 
+def bench_serving_decode():
+    """ray_tpu.llm continuous batching vs static (gang-scheduled) batching.
+
+    Same engine, same jitted programs, same varied-length workload; the only
+    difference is admission policy. Static batching admits a full gang of
+    max_decode_slots requests and waits for the LONGEST one before admitting
+    the next gang, so slots idle as short requests finish; continuous
+    batching refills slots every iteration. Reported tokens/sec is decode
+    throughput; occupancy is active-slots / total-slot-steps.
+    """
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig, LLMEngine
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(
+        vocab_size=512, num_layers=2, num_heads=4, embed_dim=128,
+        max_seq_len=256, dtype=jnp.float32, attention_impl="reference",
+    )
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=128, max_decode_slots=8, max_blocks_per_seq=8
+    )
+    rng = np.random.RandomState(0)
+    n_requests = 24
+    prompts = [
+        list(map(int, rng.randint(0, 512, size=rng.randint(4, 25))))
+        for _ in range(n_requests)
+    ]
+    budgets = [int(rng.randint(4, 33)) for _ in range(n_requests)]
+
+    engine = LLMEngine(cfg, ecfg, seed=0)
+    # Warm every compiled program: each prefill bucket plus the decode step.
+    for n in (5, 9, 17, 33):
+        engine.generate([[1] * n], max_new_tokens=2)
+
+    def run(gang_size: int | None) -> tuple[float, float]:
+        """gang_size=None → continuous admission; otherwise admit gangs of
+        that size and drain each fully before the next (gang_size=1 is
+        one-request-at-a-time generation)."""
+        produced = []
+
+        def admit(p, b):
+            tokens = []
+            engine.add_request(p, max_new_tokens=b, on_token=tokens.append)
+            produced.append(tokens)
+
+        t0 = time.perf_counter()
+        slot_steps = active_steps = 0
+        pending = list(zip(prompts, budgets))
+        while pending or engine.has_work():
+            if gang_size is None:
+                while pending and len(engine.scheduler.waiting) < ecfg.max_decode_slots:
+                    admit(*pending.pop(0))
+            elif not engine.has_work():
+                for p, b in pending[:gang_size]:
+                    admit(p, b)
+                del pending[:gang_size]
+            stats = engine.step()
+            slot_steps += ecfg.max_decode_slots
+            active_steps += stats["num_decoding"]
+        wall = time.perf_counter() - t0
+        total = sum(len(v) for v in produced)
+        assert total == sum(budgets)
+        return total / wall, active_steps / max(slot_steps, 1)
+
+    seq_tps, seq_occ = run(gang_size=1)
+    static_tps, static_occ = run(gang_size=ecfg.max_decode_slots)
+    cont_tps, cont_occ = run(gang_size=None)
+    report("serving_decode_sequential_tokens_per_s", seq_tps, unit="tokens/s")
+    report("serving_decode_sequential_occupancy", seq_occ, unit="frac")
+    report("serving_decode_static_tokens_per_s", static_tps, unit="tokens/s")
+    report("serving_decode_static_occupancy", static_occ, unit="frac")
+    report("serving_decode_continuous_tokens_per_s", cont_tps, unit="tokens/s")
+    report("serving_decode_continuous_occupancy", cont_occ, unit="frac")
+    report("serving_decode_vs_static_speedup", cont_tps / static_tps, unit="x")
+    report("serving_decode_vs_sequential_speedup", cont_tps / seq_tps, unit="x")
+
+
 ALL = [
     ("single_client_tasks_sync", bench_tasks_sync),
     ("single_client_tasks_async", bench_tasks_async),
@@ -350,6 +428,7 @@ ALL = [
     ("tasks_and_get_batch", bench_tasks_and_get_batch),
     ("placement_group_create_removal", bench_placement_groups),
     ("train_ingestion", bench_train_ingestion),
+    ("serving_decode", bench_serving_decode),
 ]
 
 

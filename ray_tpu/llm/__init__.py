@@ -1,0 +1,44 @@
+"""ray_tpu.llm — continuous-batching LLM inference on a paged KV cache.
+
+Pure-Python library on the actor/object core (the Ray layering principle):
+  * cache.py — block allocator over the preallocated paged KV pools
+  * model_runner.py — O(1) jitted prefill/decode programs for the GPT model
+  * scheduler.py — iteration-level admission, continuation, preemption
+  * engine.py — LLMEngine core + LLMServer engine actor
+  * serve.py — ingress deployment behind the existing HTTP proxy/replicas
+"""
+
+from ray_tpu.llm.cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    CacheOutOfBlocks,
+    blocks_for_tokens,
+)
+from ray_tpu.llm.config import EngineConfig
+from ray_tpu.llm.engine import LLMEngine, LLMServer
+from ray_tpu.llm.model_runner import GPTRunner
+from ray_tpu.llm.scheduler import (
+    FINISH_ABORTED,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    Request,
+    Scheduler,
+    Sequence,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "CacheOutOfBlocks",
+    "EngineConfig",
+    "FINISH_ABORTED",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "GPTRunner",
+    "LLMEngine",
+    "LLMServer",
+    "NULL_BLOCK",
+    "Request",
+    "Scheduler",
+    "Sequence",
+    "blocks_for_tokens",
+]

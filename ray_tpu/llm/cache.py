@@ -1,0 +1,73 @@
+"""Paged KV cache block allocator.
+
+CPU-side bookkeeping for the preallocated [num_blocks, block_size, H, D]
+device pools owned by the model runner: a free list of block ids, per-call
+alloc/free, and utilization accounting. Block 0 is never handed out — it is
+the null block that pads block tables and absorbs masked-lane scatters, so
+a gather through an id of 0 is always safe (and always masked).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+NULL_BLOCK = 0
+
+
+class CacheOutOfBlocks(Exception):
+    """Raised when an allocation cannot be satisfied; the scheduler turns
+    this into a preemption rather than letting it escape."""
+
+
+def blocks_for_tokens(num_tokens: int, block_size: int) -> int:
+    return -(-num_tokens // block_size)
+
+
+class BlockAllocator:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO reuse: a just-freed block is the next handed out, so a hot
+        # pool touches few distinct cache pages.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n < 0:
+            raise ValueError("cannot allocate a negative block count")
+        if n > len(self._free):
+            raise CacheOutOfBlocks(
+                f"requested {n} blocks, {len(self._free)} free"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"freeing block {b} that is not allocated (double free?)"
+                )
+            self._allocated.remove(b)
+            self._free.append(b)
+
+    def utilization(self) -> float:
+        return len(self._allocated) / self.num_usable

@@ -1,0 +1,80 @@
+"""Engine configuration for ray_tpu.llm.
+
+Everything here exists to keep XLA's compiled-program count O(1): fixed
+decode batch slots, a fixed block-table width, and a small set of
+power-of-two prefill buckets. The paged cache trades a static
+[num_blocks, block_size, H, D] pool for per-sequence dynamic lengths —
+the standard continuous-batching layout (vLLM-style) restated under
+XLA's static-shape constraint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    # Cache layout. Block 0 is reserved as the null/trash block: block
+    # tables pad with it, and masked lanes scatter into it.
+    block_size: int = 8
+    num_blocks: int = 128
+    # Decode runs one jitted program over exactly this many slots; idle
+    # slots compute against the null block and are ignored.
+    max_decode_slots: int = 8
+    # Static width of every block table; bounds sequence length at
+    # max_blocks_per_seq * block_size tokens.
+    max_blocks_per_seq: int = 16
+    # Prefill lengths are padded up to one of these (multiples of
+    # block_size); derived as powers of two up to max_model_len if empty.
+    prefill_buckets: Tuple[int, ...] = ()
+    # How many queued prompts may be prefilled in a single engine step.
+    max_prefills_per_step: int = 1
+    # Default generation bound when a request does not specify one.
+    default_max_new_tokens: int = 32
+
+    @property
+    def max_model_len(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def num_usable_blocks(self) -> int:
+        return self.num_blocks - 1  # block 0 is the null block
+
+    def buckets(self) -> Tuple[int, ...]:
+        if self.prefill_buckets:
+            return tuple(sorted(self.prefill_buckets))
+        out, b = [], self.block_size
+        while b < self.max_model_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_model_len)
+        return tuple(out)
+
+    def __post_init__(self):
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        if self.max_decode_slots < 1:
+            raise ValueError("max_decode_slots must be >= 1")
+        for b in self.prefill_buckets:
+            if b % self.block_size:
+                raise ValueError(
+                    f"prefill bucket {b} is not a multiple of block_size "
+                    f"{self.block_size}"
+                )
+            if b > self.max_model_len:
+                raise ValueError(
+                    f"prefill bucket {b} exceeds max_model_len "
+                    f"{self.max_model_len}"
+                )
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets():
+            if b >= n:
+                return b
+        raise ValueError(
+            f"prompt of {n} tokens exceeds max_model_len {self.max_model_len}"
+        )

@@ -1,0 +1,527 @@
+"""LLM inference engine: continuous batching over the paged KV cache.
+
+`LLMEngine` is the single-threaded core — one `step()` admits prefills,
+runs one iteration-level decode, streams tokens, and retires finished
+sequences. `LLMServer` wraps it for actor use: a background step loop, a
+blocking `generate`, and a `generate_stream` generator that pairs with
+`.options(num_returns="streaming")` on the actor handle.
+
+Observability (ray_tpu.util.metrics): tokens/sec counters, decode batch
+occupancy, cache utilization, and queue depth, all exported through the
+standard Prometheus registry.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.llm.cache import BlockAllocator, blocks_for_tokens
+from ray_tpu.llm.config import EngineConfig
+from ray_tpu.llm.model_runner import GPTRunner
+from ray_tpu.llm.scheduler import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    Request,
+    Scheduler,
+    Sequence,
+)
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.util.metrics import Counter, Gauge, get_or_create
+
+
+class LLMEngine:
+    """Not thread-safe; callers serialize access (LLMServer holds a lock)."""
+
+    def __init__(
+        self,
+        model_config: Optional[GPTConfig] = None,
+        engine_config: Optional[EngineConfig] = None,
+        params=None,
+        seed: int = 0,
+    ):
+        self.model_config = model_config or GPTConfig()
+        self.engine_config = engine_config or EngineConfig()
+        self.runner = GPTRunner(
+            self.model_config, self.engine_config, params=params, seed=seed
+        )
+        self.allocator = BlockAllocator(
+            self.engine_config.num_blocks, self.engine_config.block_size
+        )
+        self.scheduler = Scheduler(
+            self.allocator,
+            self.engine_config.max_decode_slots,
+            self.engine_config.max_blocks_per_seq,
+        )
+        self._on_token: Dict[str, Callable[[int], None]] = {}
+        self._on_finish: Dict[str, Callable[[Sequence], None]] = {}
+
+        # Engines share one registered metric per name (several engines can
+        # coexist in-process, one per Serve app); each engine is its own
+        # series via the `engine` tag.
+        self._metric_tags = {"engine": uuid.uuid4().hex[:8]}
+        self._tokens_generated = get_or_create(
+            Counter,
+            "llm_engine_generated_tokens",
+            "Tokens generated (prefill+decode)",
+            tag_keys=("engine",),
+        )
+        self._preemptions = get_or_create(
+            Counter,
+            "llm_engine_preemptions",
+            "Sequences preempted on cache pressure",
+            tag_keys=("engine",),
+        )
+        self._occupancy = get_or_create(
+            Gauge,
+            "llm_engine_batch_occupancy",
+            "Active decode slots / max_decode_slots, last step",
+            tag_keys=("engine",),
+        )
+        self._cache_util = get_or_create(
+            Gauge,
+            "llm_engine_cache_utilization",
+            "Allocated KV blocks / usable",
+            tag_keys=("engine",),
+        )
+        self._queue_depth = get_or_create(
+            Gauge,
+            "llm_engine_queue_depth",
+            "Requests waiting for a decode slot",
+            tag_keys=("engine",),
+        )
+        self._steps = 0
+        self._decode_tokens = 0
+        self._decode_slot_steps = 0
+        self._start = time.monotonic()
+
+    # ---------------- request lifecycle ----------------
+
+    def add_request(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        request_id: Optional[str] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        on_finish: Optional[Callable[[Sequence], None]] = None,
+    ) -> str:
+        ecfg = self.engine_config
+        if max_new_tokens is None:
+            max_new_tokens = ecfg.default_max_new_tokens
+        prompt_ids = [int(t) for t in prompt_ids]
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt_ids) + max_new_tokens
+        if total > ecfg.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds max_model_len "
+                f"{ecfg.max_model_len}"
+            )
+        # A preempted sequence re-prefills prompt+generated (up to total-1
+        # tokens), so the whole lifetime must fit the bucket table and the
+        # block pool — otherwise the request could never be (re)admitted and
+        # the engine would spin without progress.
+        largest_bucket = ecfg.buckets()[-1]
+        if total - 1 > largest_bucket:
+            raise ValueError(
+                f"prompt + max_new_tokens - 1 = {total - 1} exceeds the "
+                f"largest prefill bucket {largest_bucket}; raise "
+                "prefill_buckets or shorten the request"
+            )
+        need_blocks = blocks_for_tokens(total, ecfg.block_size)
+        if need_blocks > self.allocator.num_usable:
+            raise ValueError(
+                f"request needs {need_blocks} cache blocks but the pool "
+                f"only has {self.allocator.num_usable}; raise num_blocks"
+            )
+        request_id = request_id or uuid.uuid4().hex
+        active = {
+            s.request.request_id
+            for s in list(self.scheduler.waiting) + self.scheduler.running
+        }
+        if request_id in active:
+            raise ValueError(f"request_id {request_id!r} is already active")
+        req = Request(
+            request_id=request_id,
+            prompt_ids=prompt_ids,
+            max_new_tokens=max_new_tokens,
+            eos_id=eos_id,
+        )
+        if on_token is not None:
+            self._on_token[request_id] = on_token
+        if on_finish is not None:
+            self._on_finish[request_id] = on_finish
+        self.scheduler.add(Sequence(req))
+        return request_id
+
+    def abort(self, request_id: str) -> bool:
+        seq = self.scheduler.abort(request_id)
+        if seq is not None:
+            self._finished(seq)
+            return True
+        return False
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # ---------------- stepping ----------------
+
+    def step(self) -> dict:
+        """One engine iteration: admit prefills, decode every running
+        sequence one token, emit tokens, retire finished sequences."""
+        ecfg = self.engine_config
+        preempted_before = self.scheduler.num_preemptions
+
+        admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
+        for seq in admitted:
+            first = self.runner.prefill(seq.prefill_ids, seq.block_table)
+            seq.num_cached = len(seq.prefill_ids)
+            seq.generated.append(first)
+            self._emit(seq)
+            self._maybe_finish(seq)
+
+        decoding = self.scheduler.schedule_decode()
+        if decoding:
+            slots = ecfg.max_decode_slots
+            nb = ecfg.max_blocks_per_seq
+            tokens = np.zeros((slots,), np.int32)
+            positions = np.zeros((slots,), np.int32)
+            block_tables = np.zeros((slots, nb), np.int32)
+            context_lens = np.zeros((slots,), np.int32)
+            for i, seq in enumerate(decoding):
+                tokens[i] = seq.last_token
+                positions[i] = seq.num_cached
+                block_tables[i, : len(seq.block_table)] = seq.block_table
+                context_lens[i] = seq.num_cached
+            next_tokens = self.runner.decode(
+                tokens, positions, block_tables, context_lens
+            )
+            for i, seq in enumerate(decoding):
+                seq.num_cached += 1
+                seq.generated.append(int(next_tokens[i]))
+                self._emit(seq)
+                self._maybe_finish(seq)
+            self._decode_tokens += len(decoding)
+            self._decode_slot_steps += ecfg.max_decode_slots
+
+        self._steps += 1
+        preempted = self.scheduler.num_preemptions - preempted_before
+        if preempted:
+            self._preemptions.inc(preempted, tags=self._metric_tags)
+        occupancy = len(decoding) / ecfg.max_decode_slots
+        self._occupancy.set(occupancy, tags=self._metric_tags)
+        self._cache_util.set(self.allocator.utilization(), tags=self._metric_tags)
+        self._queue_depth.set(len(self.scheduler.waiting), tags=self._metric_tags)
+        return {
+            "num_prefilled": len(admitted),
+            "num_decoding": len(decoding),
+            "occupancy": occupancy,
+            "cache_utilization": self.allocator.utilization(),
+            "queue_depth": len(self.scheduler.waiting),
+            "preempted": preempted,
+        }
+
+    def _emit(self, seq: Sequence) -> None:
+        cb = self._on_token.get(seq.request.request_id)
+        while seq.emitted < len(seq.generated):
+            token = seq.generated[seq.emitted]
+            seq.emitted += 1
+            self._tokens_generated.inc(tags=self._metric_tags)
+            if cb is not None:
+                cb(token)
+
+    def _maybe_finish(self, seq: Sequence) -> None:
+        req = seq.request
+        reason = None
+        if req.eos_id is not None and seq.generated[-1] == req.eos_id:
+            reason = FINISH_EOS
+        elif len(seq.generated) >= req.max_new_tokens:
+            reason = FINISH_LENGTH
+        if reason is not None:
+            self.scheduler.finish(seq, reason)
+            self._finished(seq)
+
+    def _finished(self, seq: Sequence) -> None:
+        req_id = seq.request.request_id
+        self._on_token.pop(req_id, None)
+        cb = self._on_finish.pop(req_id, None)
+        if cb is not None:
+            cb(seq)
+
+    # ---------------- convenience ----------------
+
+    def generate(
+        self,
+        prompts: List[List[int]],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Run a batch of prompts to completion with continuous batching and
+        return their generated token ids, in request order."""
+        outputs: List[List[int]] = []
+        for prompt in prompts:
+            tokens: List[int] = []
+            self.add_request(
+                prompt,
+                max_new_tokens=max_new_tokens,
+                eos_id=eos_id,
+                on_token=tokens.append,
+            )
+            outputs.append(tokens)
+        while self.has_work():
+            self.step()
+        return outputs
+
+    def stats(self) -> dict:
+        elapsed = max(time.monotonic() - self._start, 1e-9)
+        return {
+            "steps": self._steps,
+            "decode_tokens": self._decode_tokens,
+            "mean_occupancy": (
+                self._decode_tokens / self._decode_slot_steps
+                if self._decode_slot_steps
+                else 0.0
+            ),
+            "preemptions": self.scheduler.num_preemptions,
+            "cache_utilization": self.allocator.utilization(),
+            "queue_depth": len(self.scheduler.waiting),
+            "num_running": len(self.scheduler.running),
+            "uptime_s": elapsed,
+        }
+
+
+class _RequestState:
+    __slots__ = ("tokens", "done", "seq", "error")
+
+    def __init__(self):
+        self.tokens: "queue.Queue" = queue.Queue()
+        self.done = threading.Event()
+        self.seq: Optional[Sequence] = None
+        self.error: Optional[BaseException] = None
+
+
+_STREAM_END = object()
+
+
+class LLMServer:
+    """Engine actor: background step loop + blocking / streaming generate.
+
+    Deploy with `ray_tpu.remote(LLMServer).options(max_concurrency=N)` so
+    concurrent generate calls overlap; they are continuous-batched inside
+    the one engine. `generate_stream` is a generator method — call it with
+    `.options(num_returns="streaming")` on the actor handle.
+    """
+
+    def __init__(
+        self,
+        model_config: Optional[GPTConfig] = None,
+        engine_config: Optional[EngineConfig] = None,
+        params=None,
+        seed: int = 0,
+        warmup: bool = True,
+    ):
+        self._engine = LLMEngine(
+            model_config, engine_config, params=params, seed=seed
+        )
+        if warmup:
+            # Compile every prefill bucket and the decode program now, while
+            # the actor is still initializing — a Serve deployment only
+            # reports healthy afterwards, so cold-start compile never runs
+            # under live traffic (nor under the controller's health probes).
+            ecfg = self._engine.engine_config
+            buckets = ecfg.buckets()
+            for bucket in buckets:
+                # Prompt length landing in this bucket, shaped so the whole
+                # request passes admission (lifetime within the largest
+                # bucket and max_model_len). 2 tokens when room allows: the
+                # second forces a decode step, compiling that program too.
+                n = bucket if bucket < buckets[-1] else bucket - 1
+                n = min(n, ecfg.max_model_len - 1)
+                budget = min(2, ecfg.max_model_len - n)
+                if n < 1:
+                    continue
+                try:
+                    self._engine.generate([[0] * n], max_new_tokens=budget)
+                except ValueError:
+                    # Bucket unwarmable under this config (e.g. the block
+                    # pool is smaller than the bucket); requests that large
+                    # are rejected at admission anyway.
+                    continue
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._requests: Dict[str, _RequestState] = {}
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._loop, name="llm-engine-loop", daemon=True
+        )
+        self._thread.start()
+
+    # ---------------- engine loop ----------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._shutdown and not self._engine.has_work():
+                    self._work.wait()
+                if self._shutdown:
+                    return
+            # Step outside the condition wait but under the lock: the engine
+            # is single-threaded and submissions mutate scheduler state.
+            with self._lock:
+                try:
+                    self._engine.step()
+                except BaseException as exc:  # surface to every waiter
+                    # Flag the crash while still holding the lock so no
+                    # submission can slip in between the error broadcast
+                    # and the thread actually dying.
+                    self._shutdown = True
+                    for state in self._requests.values():
+                        if not state.done.is_set():
+                            state.error = exc
+                            state.tokens.put(_STREAM_END)
+                            state.done.set()
+                    raise
+
+    def _submit(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: Optional[int],
+        eos_id: Optional[int],
+        request_id: Optional[str],
+    ) -> tuple[str, _RequestState]:
+        state = _RequestState()
+
+        def on_finish(seq: Sequence) -> None:
+            state.seq = seq
+            state.tokens.put(_STREAM_END)
+            state.done.set()
+
+        with self._work:
+            if self._shutdown or not self._thread.is_alive():
+                raise RuntimeError(
+                    "LLM engine loop is not running (shut down or crashed); "
+                    "restart the engine actor"
+                )
+            if request_id is not None and request_id in self._requests:
+                raise ValueError(
+                    f"request_id {request_id!r} already has an in-flight "
+                    "generation on this server"
+                )
+            rid = self._engine.add_request(
+                prompt_ids,
+                max_new_tokens=max_new_tokens,
+                eos_id=eos_id,
+                request_id=request_id,
+                on_token=state.tokens.put,
+                on_finish=on_finish,
+            )
+            self._requests[rid] = state
+            self._work.notify_all()
+        return rid, state
+
+    # ---------------- public API ----------------
+
+    def generate(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        request_id: Optional[str] = None,
+        timeout_s: float = 120.0,
+    ) -> dict:
+        rid, state = self._submit(prompt_ids, max_new_tokens, eos_id, request_id)
+        try:
+            if not state.done.wait(timeout=timeout_s):
+                # The request may have finished in the instant between the
+                # wait expiring and the abort landing; only a successful
+                # abort (it was still queued/running) is a real timeout —
+                # otherwise fall through and deliver the completed result.
+                if self.abort(rid) or not state.done.is_set():
+                    raise TimeoutError(
+                        f"generation {rid} timed out after {timeout_s}s"
+                    )
+            if state.error is not None:
+                raise state.error
+            token_ids = []
+            while True:
+                item = state.tokens.get_nowait()
+                if item is _STREAM_END:
+                    break
+                token_ids.append(item)
+            return {
+                "request_id": rid,
+                "token_ids": token_ids,
+                "finish_reason": state.seq.finish_reason if state.seq else None,
+                "num_preemptions": state.seq.num_preemptions if state.seq else 0,
+            }
+        finally:
+            with self._lock:
+                self._requests.pop(rid, None)
+
+    def generate_stream(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        request_id: Optional[str] = None,
+        timeout_s: float = 120.0,
+    ):
+        """Yields token ids as the engine produces them."""
+        rid, state = self._submit(prompt_ids, max_new_tokens, eos_id, request_id)
+        try:
+            while True:
+                try:
+                    item = state.tokens.get(timeout=timeout_s)
+                except queue.Empty:
+                    self.abort(rid)
+                    raise TimeoutError(
+                        f"generation {rid} produced no token for {timeout_s}s"
+                    ) from None
+                if item is _STREAM_END:
+                    break
+                yield item
+            if state.error is not None:
+                raise state.error
+        finally:
+            with self._lock:
+                self._requests.pop(rid, None)
+
+    def abort(self, request_id: str) -> bool:
+        with self._lock:
+            return self._engine.abort(request_id)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return self._engine.stats()
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._engine.scheduler.waiting) + len(
+                self._engine.scheduler.running
+            )
+
+    def check_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._shutdown = True
+            # Fail in-flight requests promptly instead of leaving their
+            # callers to run out their full wait timeout.
+            exc = RuntimeError("LLM engine shut down with requests in flight")
+            for state in self._requests.values():
+                if not state.done.is_set():
+                    state.error = exc
+                    state.tokens.put(_STREAM_END)
+                    state.done.set()
+            self._work.notify_all()
+        self._thread.join(timeout=10.0)

@@ -1,0 +1,157 @@
+"""Jitted prefill / decode step functions over the paged KV cache.
+
+XLA compiles O(1) programs: one decode program (fixed [max_decode_slots]
+batch, fixed block-table width) and one prefill program per power-of-two
+bucket. The cache pools are [L, num_blocks, block_size, H, D] device arrays
+threaded functionally through every step with donated buffers, so steps
+update the cache in place without host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.config import EngineConfig
+from ray_tpu.models.gpt import GPT, GPTConfig, collect_kv_caches
+
+
+class GPTRunner:
+    """Owns the params, the paged cache pools, and the compiled steps."""
+
+    def __init__(
+        self,
+        model_config: GPTConfig,
+        engine_config: EngineConfig,
+        params=None,
+        seed: int = 0,
+    ):
+        if engine_config.max_model_len > model_config.max_seq_len:
+            raise ValueError(
+                f"cache capacity {engine_config.max_model_len} tokens/seq "
+                f"exceeds model max_seq_len {model_config.max_seq_len}"
+            )
+        self.model_config = model_config
+        self.engine_config = engine_config
+        self.model = GPT(model_config)
+        if params is None:
+            probe = jnp.zeros((1, engine_config.block_size), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), probe)
+        self.params = params
+
+        cfg, ecfg = model_config, engine_config
+        cache_shape = (
+            cfg.num_layers,
+            ecfg.num_blocks,
+            ecfg.block_size,
+            cfg.num_heads,
+            cfg.head_dim,
+        )
+        self.k_cache = jnp.zeros(cache_shape, cfg.dtype)
+        self.v_cache = jnp.zeros(cache_shape, cfg.dtype)
+        self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(self._prefill_step, donate_argnums=(1, 2))
+
+    # ---------------- prefill ----------------
+
+    def _prefill_step(self, params, k_cache, v_cache, tokens, blocks, true_len):
+        """tokens [1, S_bucket], blocks [S_bucket // bs] (0-padded),
+        true_len scalar → (k_cache, v_cache, next_token)."""
+        cfg, ecfg = self.model_config, self.engine_config
+        logits, state = self.model.apply(
+            params, tokens, return_kv=True, mutable=["intermediates"]
+        )
+        kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+        s = tokens.shape[1]
+        nb = s // ecfg.block_size
+        for layer, (k, v) in enumerate(kvs):
+            paged = (nb, ecfg.block_size, cfg.num_heads, cfg.head_dim)
+            k_cache = k_cache.at[layer, blocks].set(
+                k[0].reshape(paged).astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[layer, blocks].set(
+                v[0].reshape(paged).astype(v_cache.dtype)
+            )
+        next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
+        return k_cache, v_cache, next_token
+
+    def prefill(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
+        """Run one prompt through the model, scatter its K/V into the given
+        blocks, and return the greedily-sampled next token."""
+        ecfg = self.engine_config
+        n = len(token_ids)
+        bucket = ecfg.bucket_for(n)
+        nb = bucket // ecfg.block_size
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = token_ids
+        # Bucket padding beyond the sequence's own blocks scatters into the
+        # null block; it is garbage that nothing ever reads unmasked.
+        blocks = np.zeros((nb,), np.int32)
+        blocks[: len(block_ids)] = block_ids
+        self.k_cache, self.v_cache, next_token = self._prefill_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(blocks),
+            jnp.int32(n),
+        )
+        return int(next_token)
+
+    # ---------------- decode ----------------
+
+    def _decode_step(
+        self, params, k_cache, v_cache, tokens, positions, block_tables,
+        context_lens,
+    ):
+        """One iteration-level decode over all slots. tokens/positions [B],
+        block_tables [B, nb], context_lens [B] → (k_cache, v_cache,
+        next_tokens [B])."""
+        cfg = self.model_config
+        bs = self.engine_config.block_size
+        b = tokens.shape[0]
+        logits, state = self.model.apply(
+            params,
+            tokens[:, None],
+            positions=positions[:, None],
+            paged_caches=(k_cache, v_cache, block_tables, context_lens),
+            mutable=["intermediates"],
+        )
+        kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+        # Scatter each slot's new-token K/V at its absolute position. Idle
+        # slots carry an all-null block table, so they land in block 0.
+        block_ids = block_tables[jnp.arange(b), positions // bs]
+        offsets = positions % bs
+        for layer, (k, v) in enumerate(kvs):
+            k_cache = k_cache.at[layer, block_ids, offsets].set(
+                k[:, 0].astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[layer, block_ids, offsets].set(
+                v[:, 0].astype(v_cache.dtype)
+            )
+        next_tokens = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return k_cache, v_cache, next_tokens
+
+    def decode(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        block_tables: np.ndarray,
+        context_lens: np.ndarray,
+    ) -> np.ndarray:
+        """Batched single-token decode; arrays must already be padded to
+        [max_decode_slots] / [max_decode_slots, max_blocks_per_seq]."""
+        self.k_cache, self.v_cache, next_tokens = self._decode_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32),
+        )
+        return np.asarray(next_tokens)

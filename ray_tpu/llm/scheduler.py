@@ -1,0 +1,175 @@
+"""Iteration-level (continuous batching) scheduler.
+
+Every engine step: admit queued prompts into free decode slots while the
+cache has room, continue every running sequence by one token, and preempt
+under cache pressure. Preemption is recompute-style: the victim's blocks
+are freed and it re-enters the front of the waiting queue with its
+already-generated tokens folded into the prompt, so a later prefill
+restores its state exactly (tokens already streamed out are not re-emitted
+— `emitted` survives preemption).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, List, Optional
+
+from ray_tpu.llm.cache import BlockAllocator, CacheOutOfBlocks, blocks_for_tokens
+
+
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+FINISH_ABORTED = "aborted"
+
+_arrival = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_ids: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+
+
+class Sequence:
+    """One request's in-flight state."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.generated: List[int] = []
+        self.block_table: List[int] = []
+        self.num_cached = 0  # tokens whose K/V sit in the paged cache
+        self.emitted = 0  # generated tokens already streamed to the caller
+        self.arrival = next(_arrival)
+        self.finish_reason: Optional[str] = None
+        self.num_preemptions = 0
+
+    @property
+    def prefill_ids(self) -> List[int]:
+        # After a preemption the generated suffix is recomputed as prompt.
+        return self.request.prompt_ids + self.generated
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1] if self.generated else self.request.prompt_ids[-1]
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+class Scheduler:
+    def __init__(
+        self,
+        allocator: BlockAllocator,
+        max_decode_slots: int,
+        max_blocks_per_seq: int,
+    ):
+        self.allocator = allocator
+        self.max_decode_slots = max_decode_slots
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []  # arrival order
+        self.num_preemptions = 0
+
+    # ---------------- queue management ----------------
+
+    def add(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def abort(self, request_id: str) -> Optional[Sequence]:
+        for i, seq in enumerate(self.running):
+            if seq.request.request_id == request_id:
+                self.running.pop(i)
+                self._release(seq)
+                seq.finish_reason = FINISH_ABORTED
+                return seq
+        for i, seq in enumerate(self.waiting):
+            if seq.request.request_id == request_id:
+                del self.waiting[i]
+                seq.finish_reason = FINISH_ABORTED
+                return seq
+        return None
+
+    # ---------------- admission (prefill) ----------------
+
+    def schedule_prefills(self, max_prefills: int) -> List[Sequence]:
+        """Admit waiting sequences into free slots, FIFO, while the cache
+        can hold their full prompt (plus-generated, after preemption)."""
+        admitted: List[Sequence] = []
+        while (
+            self.waiting
+            and len(self.running) < self.max_decode_slots
+            and len(admitted) < max_prefills
+        ):
+            seq = self.waiting[0]
+            need = blocks_for_tokens(
+                len(seq.prefill_ids), self.allocator.block_size
+            )
+            if not self.allocator.can_allocate(need):
+                break  # head-of-line blocking is deliberate: FIFO fairness
+            self.waiting.popleft()
+            seq.block_table = self.allocator.allocate(need)
+            admitted.append(seq)
+            self.running.append(seq)
+        return admitted
+
+    # ---------------- decode ----------------
+
+    def schedule_decode(self) -> List[Sequence]:
+        """Ensure every running sequence owns a block for the position its
+        next token will be written to; preempt the youngest sequences on
+        cache pressure. Returns the surviving running list."""
+        survivors: List[Sequence] = []
+        for seq in list(self.running):
+            if seq not in self.running:
+                continue  # preempted by an earlier iteration of this loop
+            needed = seq.num_cached // self.allocator.block_size + 1
+            if needed > self.max_blocks_per_seq:
+                raise RuntimeError(
+                    f"sequence {seq.request.request_id} outgrew "
+                    f"max_blocks_per_seq={self.max_blocks_per_seq}; the "
+                    "engine must bound prompt+max_new_tokens at admission"
+                )
+            while len(seq.block_table) < needed:
+                try:
+                    seq.block_table.extend(self.allocator.allocate(1))
+                except CacheOutOfBlocks:
+                    # Evict the lowest-priority (youngest-arrival) running
+                    # sequence — possibly the requester itself.
+                    victim = max(self.running, key=lambda s: s.arrival)
+                    self.preempt(victim)
+                    if victim in survivors:
+                        survivors.remove(victim)
+                    if victim is seq:
+                        break
+            else:
+                survivors.append(seq)
+        return survivors
+
+    def preempt(self, seq: Sequence) -> None:
+        """Recompute-style preemption: free the blocks, fold generated
+        tokens into the prompt, and put the sequence at the front of the
+        waiting queue so it resumes first."""
+        self.running.remove(seq)
+        self._release(seq)
+        seq.num_preemptions += 1
+        self.num_preemptions += 1
+        self.waiting.appendleft(seq)
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        self.running.remove(seq)
+        self._release(seq)
+        seq.finish_reason = reason
+
+    def _release(self, seq: Sequence) -> None:
+        if seq.block_table:
+            self.allocator.free(seq.block_table)
+        seq.block_table = []
+        seq.num_cached = 0

@@ -1,0 +1,134 @@
+"""Serve integration: proxy → replica → engine actor → paged cache.
+
+The ingress deployment is thin — replicas forward requests to one shared,
+named `LLMServer` engine actor, so scaling HTTP replicas does not duplicate
+model weights or split the continuous batch. Streaming responses ride the
+actor streaming-generator path into Serve's ndjson/`stream=True` plumbing.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm.config import EngineConfig
+from ray_tpu.llm.engine import LLMServer
+from ray_tpu.models.gpt import GPTConfig
+
+
+def get_or_create_engine_actor(
+    engine_name: str = "default",
+    model_config: Optional[GPTConfig] = None,
+    engine_config: Optional[EngineConfig] = None,
+    params=None,
+    seed: int = 0,
+    max_concurrency: int = 32,
+):
+    """Named engine actor shared by every ingress replica."""
+    return (
+        ray_tpu.remote(LLMServer)
+        .options(
+            name=f"llm_engine:{engine_name}",
+            get_if_exists=True,
+            max_concurrency=max_concurrency,
+        )
+        .remote(model_config, engine_config, params, seed)
+    )
+
+
+class LLMIngress:
+    """Deployment callable: JSON dict in, generated token ids (or a token
+    stream) out.
+
+    Request schema: {"prompt_ids": [int, ...], "max_new_tokens": int?,
+    "eos_id": int?, "stream": bool?}.
+    """
+
+    def __init__(
+        self,
+        engine_name: str = "default",
+        model_config: Optional[GPTConfig] = None,
+        engine_config: Optional[EngineConfig] = None,
+        params=None,
+        seed: int = 0,
+    ):
+        self._engine = get_or_create_engine_actor(
+            engine_name, model_config, engine_config, params=params, seed=seed
+        )
+
+    def __call__(self, request: dict):
+        if not isinstance(request, dict) or "prompt_ids" not in request:
+            raise ValueError(
+                'LLM requests must be {"prompt_ids": [...], ...}, got '
+                f"{type(request).__name__}"
+            )
+        prompt_ids = request["prompt_ids"]
+        max_new_tokens = request.get("max_new_tokens")
+        eos_id = request.get("eos_id")
+        if request.get("stream"):
+            refs = self._engine.generate_stream.options(
+                num_returns="streaming"
+            ).remote(prompt_ids, max_new_tokens, eos_id)
+
+            def token_stream():
+                for ref in refs:
+                    yield {"token_id": ray_tpu.get(ref)}
+
+            return token_stream()
+        return ray_tpu.get(
+            self._engine.generate.remote(prompt_ids, max_new_tokens, eos_id)
+        )
+
+    def metrics(self) -> dict:
+        return ray_tpu.get(self._engine.metrics.remote())
+
+    def check_health(self) -> bool:
+        """Replica health forwards to the engine, but a busy engine (e.g.
+        compiling a new bucket) must read as healthy — the controller's probe
+        window is short and killing the replica would not unblock anything.
+        Only a dead/raising engine fails the probe (the replacement replica
+        then re-creates the named engine actor)."""
+        from ray_tpu.exceptions import ActorError
+
+        try:
+            return bool(
+                ray_tpu.get(self._engine.check_health.remote(), timeout=1.0)
+            )
+        except TimeoutError:
+            return True
+        except ActorError:
+            return False
+
+
+def build_app(
+    model_config: Optional[GPTConfig] = None,
+    engine_config: Optional[EngineConfig] = None,
+    *,
+    params=None,
+    engine_name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 32,
+    seed: int = 0,
+) -> serve.Application:
+    """Bind the LLM ingress for `serve.run` (HTTP via the existing proxy:
+    POST /<app> with the request JSON). Pass trained weights via `params`;
+    without them the engine serves a seed-initialized model.
+
+    Each build_app call gets its own engine actor by default — the engine
+    is keyed by `engine_name`, so two apps share one engine (one copy of
+    the weights, one continuous batch) only when given the same explicit
+    name. Never reuse a name across different model configs/params: the
+    first creation wins and later apps would silently serve its weights."""
+    if engine_name is None:
+        engine_name = uuid.uuid4().hex[:8]
+    deployment = serve.deployment(
+        LLMIngress,
+        name="LLMIngress",
+        num_replicas=num_replicas,
+        max_concurrent_queries=max_concurrent_queries,
+    )
+    return deployment.bind(
+        engine_name, model_config, engine_config, params=params, seed=seed
+    )

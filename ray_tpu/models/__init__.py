@@ -1,6 +1,7 @@
 from ray_tpu.models.gpt import (
     GPT,
     GPTConfig,
+    collect_kv_caches,
     collect_moe_losses,
     cross_entropy_loss,
     gpt2_125m,
@@ -22,6 +23,7 @@ __all__ = [
     "GPTConfig",
     "MoEConfig",
     "MoEMlp",
+    "collect_kv_caches",
     "collect_moe_losses",
     "ResNet",
     "ResNet18",

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops import attention as attention_op
+from ray_tpu.ops import paged_attention
 from ray_tpu.ops.flash_attention import flash_attention_packed
 from ray_tpu.ops.ring_attention import ring_attention
 
@@ -82,12 +83,43 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        deterministic: bool = True,
+        *,
+        return_kv: bool = False,
+        paged_state: Optional[tuple] = None,
+    ):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
         b, s, _ = h.shape
         qkv = _dense(3 * cfg.embed_dim, ("embed", "heads"), cfg.dtype, name="attn_qkv")(h)
-        if cfg.attention_impl == "flash" and s <= 2048:
+        if return_kv or paged_state is not None:
+            # Generation paths (ray_tpu.llm). Both need this layer's K/V
+            # exposed: prefill sows the prompt's K/V for the engine to
+            # scatter into the paged cache; decode attends over the cache
+            # through the block table and sows the single new-token K/V.
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+            if paged_state is not None:
+                k_cache_l, v_cache_l, block_tables, context_lens = paged_state
+                attn = paged_attention(
+                    q, k_cache_l, v_cache_l, block_tables, context_lens,
+                    new_k=k, new_v=v,
+                )
+            else:
+                impl = (
+                    "reference"
+                    if cfg.attention_impl == "ring"
+                    else cfg.attention_impl
+                )
+                attn = attention_op(q, k, v, causal=True, impl=impl)
+            self.sow("intermediates", "kv_cache", (k, v))
+            attn = attn.reshape(b, s, cfg.embed_dim)
+        elif cfg.attention_impl == "flash" and s <= 2048:
             # Packed kernel consumes the projection output directly: no
             # split / head reshape / fold transposes in the graph, dqkv
             # comes back packed for the projection's grad matmul.
@@ -134,7 +166,28 @@ class GPT(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(
+        self,
+        tokens,
+        deterministic: bool = True,
+        *,
+        positions: Optional[jax.Array] = None,
+        return_kv: bool = False,
+        paged_caches: Optional[tuple] = None,
+    ):
+        """Forward pass.
+
+        Generation variants for ray_tpu.llm (same parameters, no fork):
+          * ``return_kv=True`` (prefill): apply with
+            ``mutable=["intermediates"]`` and read each layer's prompt K/V
+            back via :func:`collect_kv_caches`.
+          * ``paged_caches=(k_cache, v_cache, block_tables, context_lens)``
+            (decode): k/v_cache are [L, num_blocks, block_size, H, D] paged
+            pools; tokens is [B, 1] and ``positions`` [B, 1] must carry each
+            sequence's absolute position. Attention reads the cache through
+            the block table (ops.paged_attention); the new token's K/V is
+            sown for the caller to scatter into the cache.
+        """
         cfg = self.config
         b, s = tokens.shape
         wte = nn.Embed(
@@ -155,13 +208,24 @@ class GPT(nn.Module):
             ),
             name="wpe",
         )
-        x = wte(tokens) + wpe(jnp.arange(s)[None, :])
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        x = wte(tokens) + wpe(positions)
         for i in range(cfg.num_layers):
             use_moe = bool(
                 cfg.num_experts and (i % cfg.moe_every == cfg.moe_every - 1)
             )
+            paged_state = None
+            if paged_caches is not None:
+                k_cache, v_cache, block_tables, context_lens = paged_caches
+                paged_state = (
+                    k_cache[i], v_cache[i], block_tables, context_lens
+                )
             x = Block(cfg, use_moe=use_moe, name=f"h_{i}")(
-                x, deterministic=deterministic
+                x,
+                deterministic=deterministic,
+                return_kv=return_kv,
+                paged_state=paged_state,
             )
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Tied LM head: logits via the embedding matrix. The matmul runs in
@@ -192,6 +256,23 @@ def logical_axis_rules(rules_table: dict) -> list[tuple[str, Any]]:
     """Convert a ray_tpu.parallel rules table into flax logical-axis rules
     (for nn.logical_to_mesh_sharding)."""
     return [(name, axis) for name, axis in rules_table.items()]
+
+
+def collect_kv_caches(
+    intermediates: Any, num_layers: int
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Per-layer (k, v) sown by Blocks under `kv_cache`, in layer order.
+
+    Pair with `model.apply(..., return_kv=True, mutable=["intermediates"])`
+    (prefill) or a `paged_caches=` decode apply: each entry is the K/V the
+    layer computed for the *input* tokens — [B, S, H, D] for prefill, and
+    [B, 1, H, D] for a decode step (the token whose cache write the caller
+    owns)."""
+    out = []
+    for i in range(num_layers):
+        entry = intermediates[f"h_{i}"]["kv_cache"]
+        out.append(entry[0] if isinstance(entry, (tuple, list)) else entry)
+    return out
 
 
 def collect_moe_losses(intermediates: Any) -> jax.Array:

@@ -1,4 +1,4 @@
-from ray_tpu.ops.attention import mha_reference
+from ray_tpu.ops.attention import mha_reference, paged_attention
 from ray_tpu.ops.flash_attention import attention, flash_attention
 from ray_tpu.ops.ring_attention import ring_attention, ring_self_attention
 
@@ -6,6 +6,7 @@ __all__ = [
     "attention",
     "flash_attention",
     "mha_reference",
+    "paged_attention",
     "ring_attention",
     "ring_self_attention",
 ]

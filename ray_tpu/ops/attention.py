@@ -42,6 +42,60 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
+def paged_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    *,
+    new_k: Optional[jax.Array] = None,
+    new_v: Optional[jax.Array] = None,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode-step attention reading K/V through per-sequence block tables.
+
+    The KV cache is paged: `k_cache`/`v_cache` are [num_blocks, block_size,
+    H, D] pools, and each sequence owns a list of block ids. Shapes are fully
+    static — every sequence gathers `max_blocks_per_seq * block_size` cache
+    slots and positions >= its `context_len` are masked, so XLA compiles one
+    program regardless of how long each sequence actually is.
+
+    q:            [B, 1, H, D]  one new-token query per batch slot.
+    k_cache:      [N, bs, H, D] shared block pool (block 0 is the null block).
+    block_tables: [B, nb] int32, padded with 0 past each sequence's blocks.
+    context_lens: [B] int32 — tokens already written to the cache.
+    new_k/new_v:  [B, 1, H, D] the current token's K/V. It has not been
+                  scattered into the cache yet, so it rides along as one
+                  extra slot that is always attended (the i<=i diagonal).
+
+    Returns [B, 1, H, D].
+    """
+    b, _, h, d = q.shape
+    nb = block_tables.shape[1]
+    bs = k_cache.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    # Gather the pages: [B, nb, bs, H, D] -> [B, nb*bs, H, D].
+    k_ctx = k_cache[block_tables].reshape(b, nb * bs, h, d)
+    v_ctx = v_cache[block_tables].reshape(b, nb * bs, h, d)
+    valid = jnp.arange(nb * bs)[None, :] < context_lens[:, None]  # [B, S]
+    if new_k is not None:
+        k_ctx = jnp.concatenate([k_ctx, new_k], axis=1)
+        v_ctx = jnp.concatenate([v_ctx, new_v], axis=1)
+        valid = jnp.concatenate(
+            [valid, jnp.ones((b, 1), dtype=bool)], axis=1
+        )
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_ctx, preferred_element_type=jnp.float32
+    )
+    logits = logits * sm_scale
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_ctx.dtype), v_ctx)
+    return out.astype(q.dtype)
+
+
 def _chunk_attn_partial(
     q: jax.Array,
     k: jax.Array,

@@ -46,7 +46,9 @@ def ring_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    from ray_tpu._private.jax_compat import axis_size
+
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
     b, _, h, _ = q.shape
@@ -99,7 +101,7 @@ def ring_self_attention(
 ) -> jax.Array:
     """Convenience wrapper: shard_map `ring_attention` over the mesh with the
     sequence dim on `seq_axis` and batch on the data axes."""
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
 
     spec = P(batch_axes, seq_axis, None, None)
     fn = functools.partial(

@@ -19,8 +19,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
 
 
 def stack_stage_params(stage_params: list) -> Any:

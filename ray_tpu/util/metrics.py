@@ -193,6 +193,21 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def get_or_create(kind_cls, name: str, description: str = "", **kwargs):
+    """Return the already-registered metric of this name/kind, or create it.
+
+    Constructing a Metric always (re)binds the registry entry, so components
+    that may be instantiated several times per process (e.g. one engine per
+    Serve app) must share one instance — otherwise the newest instance
+    silently evicts the older ones' series from the exposition. Distinguish
+    instances with tags, not with separate metric objects."""
+    with _REGISTRY_LOCK:
+        existing = _REGISTRY.get(name)
+    if existing is not None and isinstance(existing, kind_cls):
+        return existing
+    return kind_cls(name, description, **kwargs)
+
+
 def clear_registry() -> None:
     """Test helper."""
     with _REGISTRY_LOCK:

@@ -111,6 +111,56 @@ def test_gpt_causality(tiny_gpt):
     )
 
 
+def test_gpt_cache_carrying_forward(tiny_gpt):
+    """The decode=paged / return_kv generation variants reuse the training
+    parameters (no fork) and reproduce the plain forward's math."""
+    from ray_tpu.models.gpt import collect_kv_caches
+
+    cfg, model, tokens, params = tiny_gpt
+    # Prefill: logits unchanged, per-layer K/V exposed via intermediates.
+    logits_plain = model.apply(params, tokens)
+    logits_kv, state = model.apply(
+        params, tokens, return_kv=True, mutable=["intermediates"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_plain), np.asarray(logits_kv), atol=1e-5
+    )
+    kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+    b, s = tokens.shape
+    assert len(kvs) == cfg.num_layers
+    assert kvs[0][0].shape == (b, s, cfg.num_heads, cfg.head_dim)
+
+    # Decode: scatter seq 0's prompt K/V into a paged cache, then a one-token
+    # cached step must match the full forward on prompt+token.
+    block_size, num_blocks, nb_pad = 16, 8, 4
+    n_blocks = s // block_size
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_heads, cfg.head_dim)
+    k_cache, v_cache = jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+    blocks = jnp.arange(1, n_blocks + 1)
+    for layer, (k, v) in enumerate(kvs):
+        paged = (n_blocks, block_size, cfg.num_heads, cfg.head_dim)
+        k_cache = k_cache.at[layer, blocks].set(k[0].reshape(paged))
+        v_cache = v_cache.at[layer, blocks].set(v[0].reshape(paged))
+    next_tok = jnp.argmax(logits_kv[0, s - 1]).astype(jnp.int32)
+    table = jnp.zeros((1, nb_pad), jnp.int32).at[0, :n_blocks].set(blocks)
+    dec_logits, dec_state = model.apply(
+        params,
+        next_tok[None, None],
+        positions=jnp.full((1, 1), s),
+        paged_caches=(k_cache, v_cache, table, jnp.asarray([s], jnp.int32)),
+        mutable=["intermediates"],
+    )
+    full = model.apply(
+        params, jnp.concatenate([tokens[0:1], next_tok[None, None]], axis=1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dec_logits[0, 0]), np.asarray(full[0, s]), atol=2e-4
+    )
+    # The new token's K/V comes back for the caller's cache write.
+    dec_kvs = collect_kv_caches(dec_state["intermediates"], cfg.num_layers)
+    assert dec_kvs[0][0].shape == (1, 1, cfg.num_heads, cfg.head_dim)
+
+
 def test_gpt_tp_sharded_init():
     """Logical axis annotations map onto the mesh: mlp kernels sharded on tp."""
     mesh = MeshSpec(fsdp=2, tp=4).build()

@@ -200,6 +200,91 @@ def test_batch_pad_to_bucket():
     assert all(c in (1, 2, 4, 8) for c in calls)
 
 
+def test_batch_exactly_max_batch_size():
+    """A batch that fills max_batch_size flushes immediately, is never
+    padded past the cap, and fans every result back out."""
+    calls = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=5.0, pad_to_bucket=True)
+    def process(items):
+        calls.append(len(items))
+        return [x * 10 for x in items]
+
+    out = []
+    threads = [
+        threading.Thread(target=lambda i=i: out.append(process(i)))
+        for i in range(4)
+    ]
+    start = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # Flushed on the size trigger, not the 5s timer.
+    assert time.time() - start < 4.0
+    assert sorted(out) == [0, 10, 20, 30]
+    assert calls and max(calls) <= 4
+
+
+def test_batch_of_one_pads_to_bucket_of_one():
+    calls = []
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05, pad_to_bucket=True)
+    def process(items):
+        calls.append(len(items))
+        return [x + 100 for x in items]
+
+    assert process(7) == 107
+    assert calls == [1]  # bucket for n=1 is 1; no phantom padding items
+
+
+def test_batch_error_fans_out_to_all_waiters():
+    attempts = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def explode(items):
+        attempts.append(len(items))
+        raise RuntimeError("batch failed")
+
+    errors = []
+
+    def fire(i):
+        try:
+            explode(i)
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # Every waiter in the failed batch got the error, none hung.
+    assert errors == ["batch failed"] * 3
+    assert sum(attempts) == 3
+
+
+def test_batch_wrong_result_count_raises_for_all():
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def short_changed(items):
+        return items[:-1]  # one result missing
+
+    errors = []
+
+    def fire(i):
+        try:
+            short_changed(i)
+        except ValueError as e:
+            errors.append("results" in str(e))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert errors == [True, True]
+
+
 def test_status_and_shutdown(serve_instance):
     @serve.deployment
     def f(x):
