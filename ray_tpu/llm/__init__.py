@@ -1,18 +1,24 @@
 """ray_tpu.llm — continuous-batching LLM inference on a paged KV cache.
 
 Pure-Python library on the actor/object core (the Ray layering principle):
-  * cache.py — block allocator over the preallocated paged KV pools
-  * model_runner.py — O(1) jitted prefill/decode programs for the GPT model
-  * scheduler.py — iteration-level admission, continuation, preemption
+  * cache.py — refcounted, content-addressed block allocator over the
+    preallocated paged KV pools (automatic prefix caching)
+  * model_runner.py — O(1) jitted prefill/partial-prefill/decode programs
+    for the GPT model
+  * scheduler.py — iteration-level prefix-aware admission, continuation,
+    preemption
   * engine.py — LLMEngine core + LLMServer engine actor
   * serve.py — ingress deployment behind the existing HTTP proxy/replicas
 """
 
 from ray_tpu.llm.cache import (
+    EVICTION_POLICIES,
     NULL_BLOCK,
     BlockAllocator,
     CacheOutOfBlocks,
     blocks_for_tokens,
+    hash_block_tokens,
+    prefix_block_hashes,
 )
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.llm.engine import LLMEngine, LLMServer
@@ -29,6 +35,7 @@ from ray_tpu.llm.scheduler import (
 __all__ = [
     "BlockAllocator",
     "CacheOutOfBlocks",
+    "EVICTION_POLICIES",
     "EngineConfig",
     "FINISH_ABORTED",
     "FINISH_EOS",
@@ -41,4 +48,6 @@ __all__ = [
     "Scheduler",
     "Sequence",
     "blocks_for_tokens",
+    "hash_block_tokens",
+    "prefix_block_hashes",
 ]

@@ -33,6 +33,15 @@ class EngineConfig:
     max_prefills_per_step: int = 1
     # Default generation bound when a request does not specify one.
     default_max_new_tokens: int = 32
+    # Automatic prefix caching: full KV blocks are content-addressed
+    # (chain-hashed token ids) and freed blocks stay reusable until
+    # evicted, so shared system prompts, repeated prompts, and
+    # preempt-resume re-prefills skip recomputing the cached prefix.
+    # Greedy outputs are token-identical either way.
+    enable_prefix_caching: bool = True
+    # Which cached-but-unreferenced block to evict under pressure:
+    # "lru" (least recently freed/used) or "fifo" (oldest registration).
+    prefix_eviction_policy: str = "lru"
 
     @property
     def max_model_len(self) -> int:
@@ -59,6 +68,13 @@ class EngineConfig:
             raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
         if self.max_decode_slots < 1:
             raise ValueError("max_decode_slots must be >= 1")
+        from ray_tpu.llm.cache import EVICTION_POLICIES
+
+        if self.prefix_eviction_policy not in EVICTION_POLICIES:
+            raise ValueError(
+                f"prefix_eviction_policy must be one of {EVICTION_POLICIES},"
+                f" got {self.prefix_eviction_policy!r}"
+            )
         for b in self.prefill_buckets:
             if b % self.block_size:
                 raise ValueError(

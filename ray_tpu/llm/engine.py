@@ -51,7 +51,10 @@ class LLMEngine:
             self.model_config, self.engine_config, params=params, seed=seed
         )
         self.allocator = BlockAllocator(
-            self.engine_config.num_blocks, self.engine_config.block_size
+            self.engine_config.num_blocks,
+            self.engine_config.block_size,
+            enable_prefix_caching=self.engine_config.enable_prefix_caching,
+            eviction_policy=self.engine_config.prefix_eviction_policy,
         )
         self.scheduler = Scheduler(
             self.allocator,
@@ -95,9 +98,29 @@ class LLMEngine:
             "Requests waiting for a decode slot",
             tag_keys=("engine",),
         )
+        self._prefix_hits = get_or_create(
+            Counter,
+            "llm_engine_prefix_cache_hit_tokens",
+            "Prompt tokens served from the prefix cache instead of computed",
+            tag_keys=("engine",),
+        )
+        self._prefix_hit_rate = get_or_create(
+            Gauge,
+            "llm_engine_prefix_cache_hit_rate",
+            "Cumulative prefix-cache hit tokens / prefill tokens",
+            tag_keys=("engine",),
+        )
+        self._evictable_blocks = get_or_create(
+            Gauge,
+            "llm_engine_evictable_blocks",
+            "Cached-but-unreferenced KV blocks (reusable until evicted)",
+            tag_keys=("engine",),
+        )
         self._steps = 0
         self._decode_tokens = 0
         self._decode_slot_steps = 0
+        self._prefill_tokens = 0
+        self._cache_hit_tokens = 0
         self._start = time.monotonic()
 
     # ---------------- request lifecycle ----------------
@@ -144,11 +167,7 @@ class LLMEngine:
                 f"only has {self.allocator.num_usable}; raise num_blocks"
             )
         request_id = request_id or uuid.uuid4().hex
-        active = {
-            s.request.request_id
-            for s in list(self.scheduler.waiting) + self.scheduler.running
-        }
-        if request_id in active:
+        if self.scheduler.is_active(request_id):
             raise ValueError(f"request_id {request_id!r} is already active")
         req = Request(
             request_id=request_id,
@@ -180,11 +199,28 @@ class LLMEngine:
         sequence one token, emit tokens, retire finished sequences."""
         ecfg = self.engine_config
         preempted_before = self.scheduler.num_preemptions
+        step_hit_tokens = 0
 
         admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
         for seq in admitted:
-            first = self.runner.prefill(seq.prefill_ids, seq.block_table)
+            offset = seq.num_cached  # tokens the admission matched in-cache
+            if seq.pending_copy is not None:
+                # Copy-on-write: the last matched block is shared and this
+                # prefill writes its final token's K/V into it.
+                src, dst = seq.pending_copy
+                seq.pending_copy = None
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])  # drop admission's copy-source ref
+            if offset > 0:
+                first = self.runner.prefill_suffix(
+                    seq.prefill_ids[offset:], seq.block_table, offset
+                )
+                step_hit_tokens += offset
+            else:
+                first = self.runner.prefill(seq.prefill_ids, seq.block_table)
+            self._prefill_tokens += len(seq.prefill_ids)
             seq.num_cached = len(seq.prefill_ids)
+            self.scheduler.note_filled_blocks(seq)
             seq.generated.append(first)
             self._emit(seq)
             self._maybe_finish(seq)
@@ -208,6 +244,10 @@ class LLMEngine:
             for i, seq in enumerate(decoding):
                 seq.num_cached += 1
                 seq.generated.append(int(next_tokens[i]))
+                if seq.num_cached % ecfg.block_size == 0:
+                    # A block just filled: publish it to the prefix cache
+                    # before a finish below could release it.
+                    self.scheduler.note_filled_blocks(seq)
                 self._emit(seq)
                 self._maybe_finish(seq)
             self._decode_tokens += len(decoding)
@@ -217,10 +257,20 @@ class LLMEngine:
         preempted = self.scheduler.num_preemptions - preempted_before
         if preempted:
             self._preemptions.inc(preempted, tags=self._metric_tags)
+        if step_hit_tokens:
+            self._cache_hit_tokens += step_hit_tokens
+            self._prefix_hits.inc(step_hit_tokens, tags=self._metric_tags)
         occupancy = len(decoding) / ecfg.max_decode_slots
         self._occupancy.set(occupancy, tags=self._metric_tags)
         self._cache_util.set(self.allocator.utilization(), tags=self._metric_tags)
         self._queue_depth.set(len(self.scheduler.waiting), tags=self._metric_tags)
+        self._prefix_hit_rate.set(
+            self._cache_hit_tokens / max(self._prefill_tokens, 1),
+            tags=self._metric_tags,
+        )
+        self._evictable_blocks.set(
+            self.allocator.num_evictable, tags=self._metric_tags
+        )
         return {
             "num_prefilled": len(admitted),
             "num_decoding": len(decoding),
@@ -228,6 +278,8 @@ class LLMEngine:
             "cache_utilization": self.allocator.utilization(),
             "queue_depth": len(self.scheduler.waiting),
             "preempted": preempted,
+            "cache_hit_tokens": step_hit_tokens,
+            "evictable_blocks": self.allocator.num_evictable,
         }
 
     def _emit(self, seq: Sequence) -> None:
@@ -292,9 +344,18 @@ class LLMEngine:
                 else 0.0
             ),
             "preemptions": self.scheduler.num_preemptions,
+            "num_preemptions": self.scheduler.num_preemptions,
             "cache_utilization": self.allocator.utilization(),
             "queue_depth": len(self.scheduler.waiting),
             "num_running": len(self.scheduler.running),
+            "prefill_tokens": self._prefill_tokens,
+            "prefix_cache_hit_tokens": self._cache_hit_tokens,
+            "prefix_cache_hit_rate": (
+                self._cache_hit_tokens / max(self._prefill_tokens, 1)
+            ),
+            "evictable_blocks": self.allocator.num_evictable,
+            "prefix_cache_evictions": self.allocator.num_evictions,
+            "cow_blocks": self.scheduler.num_cow_blocks,
             "uptime_s": elapsed,
         }
 
@@ -349,6 +410,11 @@ class LLMServer:
                 budget = min(2, ecfg.max_model_len - n)
                 if n < 1:
                     continue
+                # Each round must exercise the FULL prefill program: drop
+                # the previous round's cached zero-blocks, or this prompt
+                # would hit them and take the partial-prefill path, leaving
+                # this bucket's full program uncompiled.
+                self._engine.allocator.reset_prefix_cache()
                 try:
                     self._engine.generate([[0] * n], max_new_tokens=budget)
                 except ValueError:
@@ -356,6 +422,28 @@ class LLMServer:
                     # pool is smaller than the bucket); requests that large
                     # are rejected at admission anyway.
                     continue
+            if ecfg.enable_prefix_caching:
+                # Also compile every partial-prefill bucket and the
+                # copy-on-write block copy, so cache hits never trigger a
+                # cold compile under live traffic. Each round seeds exactly
+                # one cached block of zeros, then prefills a zero-prompt
+                # whose uncached suffix lands in the target bucket; the
+                # duplicate-prompt round at the end exercises the
+                # fully-cached path (CoW + smallest suffix bucket).
+                alloc = self._engine.allocator
+                bs = ecfg.block_size
+                for bucket in buckets + (0,):
+                    alloc.reset_prefix_cache()
+                    n = min(bs + bucket, ecfg.max_model_len - 1, buckets[-1])
+                    try:
+                        self._engine.generate([[0] * bs], max_new_tokens=1)
+                        if n > bs:
+                            self._engine.generate([[0] * n], max_new_tokens=1)
+                        else:  # CoW round: repeat the fully-cached prompt
+                            self._engine.generate([[0] * bs], max_new_tokens=1)
+                    except ValueError:
+                        continue
+                alloc.reset_prefix_cache()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._requests: Dict[str, _RequestState] = {}
@@ -502,6 +590,12 @@ class LLMServer:
     def metrics(self) -> dict:
         with self._lock:
             return self._engine.stats()
+
+    def reset_prefix_cache(self) -> None:
+        """Drop all cached-but-unreferenced KV blocks (e.g. after swapping
+        the served params, whose cached activations would be stale)."""
+        with self._lock:
+            self._engine.allocator.reset_prefix_cache()
 
     def num_pending(self) -> int:
         with self._lock:

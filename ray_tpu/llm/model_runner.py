@@ -1,8 +1,12 @@
 """Jitted prefill / decode step functions over the paged KV cache.
 
 XLA compiles O(1) programs: one decode program (fixed [max_decode_slots]
-batch, fixed block-table width) and one prefill program per power-of-two
-bucket. The cache pools are [L, num_blocks, block_size, H, D] device arrays
+batch, fixed block-table width), one full-prefill program per power-of-two
+bucket, one *partial*-prefill program per bucket (prefix caching: feed only
+the uncached suffix at a position offset and attend to the cached prefix
+through the block table — paged attention over the prefix, causal over the
+suffix), and one block-to-block copy (copy-on-write for shared blocks).
+The cache pools are [L, num_blocks, block_size, H, D] device arrays
 threaded functionally through every step with donated buffers, so steps
 update the cache in place without host round-trips.
 """
@@ -55,6 +59,12 @@ class GPTRunner:
         self.v_cache = jnp.zeros(cache_shape, cfg.dtype)
         self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1, 2))
         self._prefill_fn = jax.jit(self._prefill_step, donate_argnums=(1, 2))
+        self._prefill_suffix_fn = jax.jit(
+            self._prefill_suffix_step, donate_argnums=(1, 2)
+        )
+        self._copy_block_fn = jax.jit(
+            self._copy_block_step, donate_argnums=(0, 1)
+        )
 
     # ---------------- prefill ----------------
 
@@ -101,6 +111,88 @@ class GPTRunner:
             jnp.int32(n),
         )
         return int(next_token)
+
+    # ---------------- partial prefill (prefix caching) ----------------
+
+    def _prefill_suffix_step(
+        self, params, k_cache, v_cache, tokens, block_table, offset, true_len
+    ):
+        """tokens [1, S_bucket] uncached suffix (0-padded), block_table
+        [max_blocks_per_seq] the sequence's full table (0-padded), offset
+        scalar = cached prefix length, true_len scalar = real suffix length
+        → (k_cache, v_cache, next_token).
+
+        One program per suffix bucket: the suffix attends to the cached
+        prefix through the block table (paged) and to itself causally, and
+        its K/V is scattered token-by-token at positions offset..offset+S-1
+        (padded lanes land in the null block)."""
+        cfg, ecfg = self.model_config, self.engine_config
+        sb = tokens.shape[1]
+        lane = jnp.arange(sb)
+        valid = lane < true_len
+        positions = jnp.where(valid, offset + lane, 0)
+        logits, state = self.model.apply(
+            params,
+            tokens,
+            positions=positions[None, :],
+            paged_caches=(
+                k_cache,
+                v_cache,
+                block_table[None, :],
+                jnp.reshape(offset, (1,)),
+            ),
+            mutable=["intermediates"],
+        )
+        kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+        bs = ecfg.block_size
+        block_ids = jnp.where(valid, block_table[positions // bs], 0)
+        offsets = jnp.where(valid, positions % bs, 0)
+        for layer, (k, v) in enumerate(kvs):
+            k_cache = k_cache.at[layer, block_ids, offsets].set(
+                k[0].astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[layer, block_ids, offsets].set(
+                v[0].astype(v_cache.dtype)
+            )
+        next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
+        return k_cache, v_cache, next_token
+
+    def prefill_suffix(
+        self, token_ids: Sequence[int], block_ids: Sequence[int], offset: int
+    ) -> int:
+        """Prefix-aware prefill: run only the uncached suffix of a prompt
+        whose first `offset` tokens already sit in the paged cache (through
+        `block_ids`, the sequence's whole block table), scatter the suffix
+        K/V, and return the greedily-sampled next token."""
+        ecfg = self.engine_config
+        n = len(token_ids)
+        bucket = ecfg.bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = token_ids
+        table = np.zeros((ecfg.max_blocks_per_seq,), np.int32)
+        table[: len(block_ids)] = block_ids
+        self.k_cache, self.v_cache, next_token = self._prefill_suffix_fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(table),
+            jnp.int32(offset),
+            jnp.int32(n),
+        )
+        return int(next_token)
+
+    def _copy_block_step(self, k_cache, v_cache, src, dst):
+        k_cache = k_cache.at[:, dst].set(k_cache[:, src])
+        v_cache = v_cache.at[:, dst].set(v_cache[:, src])
+        return k_cache, v_cache
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Device-copy one block's K/V across every layer (copy-on-write
+        before a sequence writes into a block it shares)."""
+        self.k_cache, self.v_cache = self._copy_block_fn(
+            self.k_cache, self.v_cache, jnp.int32(src), jnp.int32(dst)
+        )
 
     # ---------------- decode ----------------
 

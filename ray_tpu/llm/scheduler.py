@@ -7,6 +7,14 @@ are freed and it re-enters the front of the waiting queue with its
 already-generated tokens folded into the prompt, so a later prefill
 restores its state exactly (tokens already streamed out are not re-emitted
 — `emitted` survives preemption).
+
+With automatic prefix caching (BlockAllocator docstring) admission is
+prefix-aware: the longest chain of cached full blocks matching the head of
+`prefill_ids` is shared via refcount bumps, and only the uncached tail is
+allocated and recomputed (the engine's partial-prefill program). Because a
+preempted victim's full blocks stay cached-but-evictable, recompute
+preemption becomes nearly free — the resume prefill is mostly cache hits
+unless the pool was under enough pressure to really evict them.
 """
 
 from __future__ import annotations
@@ -14,9 +22,15 @@ from __future__ import annotations
 import dataclasses
 import itertools
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
-from ray_tpu.llm.cache import BlockAllocator, CacheOutOfBlocks, blocks_for_tokens
+from ray_tpu.llm.cache import (
+    BlockAllocator,
+    CacheOutOfBlocks,
+    blocks_for_tokens,
+    hash_block_tokens,
+    prefix_block_hashes,
+)
 
 
 FINISH_EOS = "eos"
@@ -46,6 +60,15 @@ class Sequence:
         self.arrival = next(_arrival)
         self.finish_reason: Optional[str] = None
         self.num_preemptions = 0
+        # Membership flag so a full-slot engine step stays linear (no
+        # `seq in running` list scans).
+        self.is_running = False
+        # Chain keys of this sequence's full, cached blocks, in order.
+        self.block_hashes: List[int] = []
+        # Copy-on-write owed by the engine before this sequence's prefill:
+        # (src, dst) device block copy. Admission holds an extra ref on src
+        # until the copy lands.
+        self.pending_copy: Optional[Tuple[int, int]] = None
 
     @property
     def prefill_ids(self) -> List[int]:
@@ -73,29 +96,37 @@ class Scheduler:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []  # arrival order
+        self._active: Dict[str, Sequence] = {}  # request_id -> waiting|running
         self.num_preemptions = 0
+        self.num_cow_blocks = 0
 
     # ---------------- queue management ----------------
 
     def add(self, seq: Sequence) -> None:
+        rid = seq.request.request_id
+        if rid in self._active:
+            raise ValueError(f"request_id {rid!r} is already active")
+        self._active[rid] = seq
         self.waiting.append(seq)
+
+    def is_active(self, request_id: str) -> bool:
+        return request_id in self._active
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
     def abort(self, request_id: str) -> Optional[Sequence]:
-        for i, seq in enumerate(self.running):
-            if seq.request.request_id == request_id:
-                self.running.pop(i)
-                self._release(seq)
-                seq.finish_reason = FINISH_ABORTED
-                return seq
-        for i, seq in enumerate(self.waiting):
-            if seq.request.request_id == request_id:
-                del self.waiting[i]
-                seq.finish_reason = FINISH_ABORTED
-                return seq
-        return None
+        seq = self._active.pop(request_id, None)
+        if seq is None:
+            return None
+        if seq.is_running:
+            self.running.remove(seq)
+            seq.is_running = False
+            self._release(seq)
+        else:
+            self.waiting.remove(seq)
+        seq.finish_reason = FINISH_ABORTED
+        return seq
 
     # ---------------- admission (prefill) ----------------
 
@@ -109,16 +140,58 @@ class Scheduler:
             and len(admitted) < max_prefills
         ):
             seq = self.waiting[0]
-            need = blocks_for_tokens(
-                len(seq.prefill_ids), self.allocator.block_size
-            )
-            if not self.allocator.can_allocate(need):
+            if not self._admit(seq):
                 break  # head-of-line blocking is deliberate: FIFO fairness
             self.waiting.popleft()
-            seq.block_table = self.allocator.allocate(need)
+            seq.is_running = True
             admitted.append(seq)
             self.running.append(seq)
         return admitted
+
+    def _admit(self, seq: Sequence) -> bool:
+        """Map `seq`'s block table: share the longest cached block-prefix
+        of prefill_ids (refcount bumps) and allocate only the uncached
+        tail. Returns False when the pool cannot hold the tail."""
+        ids = seq.prefill_ids
+        n = len(ids)
+        bs = self.allocator.block_size
+        total = blocks_for_tokens(n, bs)
+        if not self.allocator.enable_prefix_caching:
+            if not self.allocator.can_allocate(total):
+                return False
+            seq.block_table = self.allocator.allocate(total)
+            seq.block_hashes = []
+            seq.num_cached = 0
+            return True
+        hashes = prefix_block_hashes(ids, bs)
+        matched = self.allocator.match_prefix(hashes)
+        k = len(matched)
+        # A fully-cached prompt still needs its last token's logits, and
+        # that token's K/V write lands inside the last matched (shared,
+        # immutable) block: copy-on-write it.
+        cow = k > 0 and k * bs == n
+        need = total - k + (1 if cow else 0)
+        # Shield the matched prefix from being evicted by the tail
+        # allocation below (and from anyone else while this seq runs).
+        self.allocator.touch(matched)
+        if not self.allocator.can_allocate(need):
+            self.allocator.free(matched)
+            return False
+        tail = self.allocator.allocate(need)
+        seq.block_hashes = hashes[:k]
+        if cow:
+            src, dst = matched[-1], tail[0]
+            seq.block_table = matched[:-1] + [dst]
+            # The engine device-copies src -> dst before the suffix prefill
+            # runs; the extra ref taken on src above is dropped after the
+            # copy (engine) or on release (abort in the same step).
+            seq.pending_copy = (src, dst)
+            seq.num_cached = n - 1
+            self.num_cow_blocks += 1
+        else:
+            seq.block_table = matched + tail
+            seq.num_cached = k * bs
+        return True
 
     # ---------------- decode ----------------
 
@@ -126,9 +199,8 @@ class Scheduler:
         """Ensure every running sequence owns a block for the position its
         next token will be written to; preempt the youngest sequences on
         cache pressure. Returns the surviving running list."""
-        survivors: List[Sequence] = []
         for seq in list(self.running):
-            if seq not in self.running:
+            if not seq.is_running:
                 continue  # preempted by an earlier iteration of this loop
             needed = seq.num_cached // self.allocator.block_size + 1
             if needed > self.max_blocks_per_seq:
@@ -142,22 +214,21 @@ class Scheduler:
                     seq.block_table.extend(self.allocator.allocate(1))
                 except CacheOutOfBlocks:
                     # Evict the lowest-priority (youngest-arrival) running
-                    # sequence — possibly the requester itself.
+                    # sequence — possibly the requester itself. Its keyed
+                    # blocks stay cached-but-evictable, so its resume
+                    # prefill is mostly hits unless pressure persists.
                     victim = max(self.running, key=lambda s: s.arrival)
                     self.preempt(victim)
-                    if victim in survivors:
-                        survivors.remove(victim)
                     if victim is seq:
                         break
-            else:
-                survivors.append(seq)
-        return survivors
+        return list(self.running)
 
     def preempt(self, seq: Sequence) -> None:
         """Recompute-style preemption: free the blocks, fold generated
         tokens into the prompt, and put the sequence at the front of the
         waiting queue so it resumes first."""
         self.running.remove(seq)
+        seq.is_running = False
         self._release(seq)
         seq.num_preemptions += 1
         self.num_preemptions += 1
@@ -165,11 +236,41 @@ class Scheduler:
 
     def finish(self, seq: Sequence, reason: str) -> None:
         self.running.remove(seq)
+        seq.is_running = False
         self._release(seq)
+        self._active.pop(seq.request.request_id, None)
         seq.finish_reason = reason
 
+    # ---------------- prefix-cache bookkeeping ----------------
+
+    def note_filled_blocks(self, seq: Sequence) -> None:
+        """Publish every newly-filled full block of `seq` under its chain
+        key so later admissions (including this sequence's own resume after
+        a preemption) can share it. Idempotent; call after prefill and
+        whenever decode fills a block."""
+        if not self.allocator.enable_prefix_caching:
+            return
+        bs = self.allocator.block_size
+        full = seq.num_cached // bs
+        if len(seq.block_hashes) >= full:
+            return
+        stream = seq.request.prompt_ids + seq.generated
+        while len(seq.block_hashes) < full:
+            j = len(seq.block_hashes)
+            prev = seq.block_hashes[-1] if seq.block_hashes else None
+            h = hash_block_tokens(prev, stream[j * bs : (j + 1) * bs])
+            seq.block_hashes.append(h)
+            self.allocator.register(seq.block_table[j], h)
+
     def _release(self, seq: Sequence) -> None:
+        if seq.pending_copy is not None:
+            # Admission holds one extra ref on the copy source until the
+            # engine performs the device copy; a release before that must
+            # drop it too.
+            self.allocator.free([seq.pending_copy[0]])
+            seq.pending_copy = None
         if seq.block_table:
             self.allocator.free(seq.block_table)
         seq.block_table = []
+        seq.block_hashes = []
         seq.num_cached = 0

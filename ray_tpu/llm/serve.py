@@ -84,6 +84,11 @@ class LLMIngress:
     def metrics(self) -> dict:
         return ray_tpu.get(self._engine.metrics.remote())
 
+    def reset_prefix_cache(self) -> None:
+        """Drop the engine's cached-but-unreferenced KV blocks (call after
+        swapping served params, whose cached activations would be stale)."""
+        ray_tpu.get(self._engine.reset_prefix_cache.remote())
+
     def check_health(self) -> bool:
         """Replica health forwards to the engine, but a busy engine (e.g.
         compiling a new bucket) must read as healthy — the controller's probe

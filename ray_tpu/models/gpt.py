@@ -96,10 +96,12 @@ class Block(nn.Module):
         b, s, _ = h.shape
         qkv = _dense(3 * cfg.embed_dim, ("embed", "heads"), cfg.dtype, name="attn_qkv")(h)
         if return_kv or paged_state is not None:
-            # Generation paths (ray_tpu.llm). Both need this layer's K/V
+            # Generation paths (ray_tpu.llm). All need this layer's K/V
             # exposed: prefill sows the prompt's K/V for the engine to
-            # scatter into the paged cache; decode attends over the cache
-            # through the block table and sows the single new-token K/V.
+            # scatter into the paged cache; decode (s == 1) and prefix-aware
+            # partial prefill (s > 1, uncached suffix only) attend over the
+            # cache through the block table — paged over the cached prefix,
+            # causal among the fed tokens — and sow the new K/V.
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
             k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
@@ -182,11 +184,14 @@ class GPT(nn.Module):
             ``mutable=["intermediates"]`` and read each layer's prompt K/V
             back via :func:`collect_kv_caches`.
           * ``paged_caches=(k_cache, v_cache, block_tables, context_lens)``
-            (decode): k/v_cache are [L, num_blocks, block_size, H, D] paged
-            pools; tokens is [B, 1] and ``positions`` [B, 1] must carry each
-            sequence's absolute position. Attention reads the cache through
-            the block table (ops.paged_attention); the new token's K/V is
-            sown for the caller to scatter into the cache.
+            (decode and prefix-aware partial prefill): k/v_cache are
+            [L, num_blocks, block_size, H, D] paged pools; tokens is [B, S]
+            (S == 1 for decode, S > 1 for the uncached suffix of a
+            partially-cached prompt) and ``positions`` [B, S] must carry
+            each token's absolute position. Attention reads the cached
+            prefix through the block table and runs causally over the fed
+            tokens (ops.paged_attention); the new K/V is sown for the
+            caller to scatter into the cache.
         """
         cfg = self.config
         b, s = tokens.shape
@@ -264,10 +269,10 @@ def collect_kv_caches(
     """Per-layer (k, v) sown by Blocks under `kv_cache`, in layer order.
 
     Pair with `model.apply(..., return_kv=True, mutable=["intermediates"])`
-    (prefill) or a `paged_caches=` decode apply: each entry is the K/V the
-    layer computed for the *input* tokens — [B, S, H, D] for prefill, and
-    [B, 1, H, D] for a decode step (the token whose cache write the caller
-    owns)."""
+    (prefill) or a `paged_caches=` apply (decode / partial prefill): each
+    entry is the K/V the layer computed for the *input* tokens —
+    [B, S, H, D] of exactly the tokens fed, whose cache writes the caller
+    owns ([B, 1, H, D] for a decode step)."""
     out = []
     for i in range(num_layers):
         entry = intermediates[f"h_{i}"]["kv_cache"]

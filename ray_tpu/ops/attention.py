@@ -53,7 +53,7 @@ def paged_attention(
     new_v: Optional[jax.Array] = None,
     sm_scale: Optional[float] = None,
 ) -> jax.Array:
-    """Decode-step attention reading K/V through per-sequence block tables.
+    """Attention over the paged KV cache through per-sequence block tables.
 
     The KV cache is paged: `k_cache`/`v_cache` are [num_blocks, block_size,
     H, D] pools, and each sequence owns a list of block ids. Shapes are fully
@@ -61,17 +61,24 @@ def paged_attention(
     slots and positions >= its `context_len` are masked, so XLA compiles one
     program regardless of how long each sequence actually is.
 
-    q:            [B, 1, H, D]  one new-token query per batch slot.
+    Handles both generation paths of ray_tpu.llm with one program shape:
+    decode is S == 1 (one new token per slot); prefix-aware partial prefill
+    is S > 1 (the uncached suffix of a prompt whose prefix K/V is already
+    resident) — paged attention over the cached prefix, causal among the
+    suffix tokens. Queries at suffix offset i attend every cached position
+    plus new tokens 0..i.
+
+    q:            [B, S, H, D]  new-token queries per batch slot.
     k_cache:      [N, bs, H, D] shared block pool (block 0 is the null block).
     block_tables: [B, nb] int32, padded with 0 past each sequence's blocks.
     context_lens: [B] int32 — tokens already written to the cache.
-    new_k/new_v:  [B, 1, H, D] the current token's K/V. It has not been
-                  scattered into the cache yet, so it rides along as one
-                  extra slot that is always attended (the i<=i diagonal).
+    new_k/new_v:  [B, S, H, D] the new tokens' K/V. They have not been
+                  scattered into the cache yet, so they ride along as extra
+                  always-gathered slots under a causal (j <= i) mask.
 
-    Returns [B, 1, H, D].
+    Returns [B, S, H, D].
     """
-    b, _, h, d = q.shape
+    b, q_len, h, d = q.shape
     nb = block_tables.shape[1]
     bs = k_cache.shape[1]
     if sm_scale is None:
@@ -79,18 +86,24 @@ def paged_attention(
     # Gather the pages: [B, nb, bs, H, D] -> [B, nb*bs, H, D].
     k_ctx = k_cache[block_tables].reshape(b, nb * bs, h, d)
     v_ctx = v_cache[block_tables].reshape(b, nb * bs, h, d)
-    valid = jnp.arange(nb * bs)[None, :] < context_lens[:, None]  # [B, S]
+    # [B, Q, K] mask: every query sees every valid cached position.
+    valid = jnp.broadcast_to(
+        (jnp.arange(nb * bs)[None, :] < context_lens[:, None])[:, None, :],
+        (b, q_len, nb * bs),
+    )
     if new_k is not None:
+        s_new = new_k.shape[1]
         k_ctx = jnp.concatenate([k_ctx, new_k], axis=1)
         v_ctx = jnp.concatenate([v_ctx, new_v], axis=1)
+        causal = jnp.tril(jnp.ones((q_len, s_new), dtype=bool), s_new - q_len)
         valid = jnp.concatenate(
-            [valid, jnp.ones((b, 1), dtype=bool)], axis=1
+            [valid, jnp.broadcast_to(causal[None], (b, q_len, s_new))], axis=2
         )
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k_ctx, preferred_element_type=jnp.float32
     )
     logits = logits * sm_scale
-    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    logits = jnp.where(valid[:, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_ctx.dtype), v_ctx)
     return out.astype(q.dtype)

@@ -26,6 +26,7 @@ from ray_tpu.llm import (
     Scheduler,
     Sequence,
     blocks_for_tokens,
+    prefix_block_hashes,
 )
 from ray_tpu.models.gpt import GPT, GPTConfig
 from ray_tpu.ops import mha_reference, paged_attention
@@ -99,6 +100,86 @@ def test_blocks_for_tokens():
     assert blocks_for_tokens(1, 8) == 1
     assert blocks_for_tokens(8, 8) == 1
     assert blocks_for_tokens(9, 8) == 2
+
+
+def test_allocator_free_duplicate_ids_is_atomic():
+    """A duplicate id anywhere in one free() call must fail before any
+    mutation — a bad free cannot leave the allocator half-updated."""
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    a = alloc.allocate(3)
+    before = (alloc.num_free, alloc.num_allocated)
+    with pytest.raises(ValueError, match="more than once"):
+        alloc.free([a[0], a[1], a[0]])
+    assert (alloc.num_free, alloc.num_allocated) == before
+    alloc.free(a)  # the same blocks still free cleanly afterwards
+    assert alloc.num_allocated == 0 and alloc.num_free == 7
+
+
+def test_allocator_prefix_cache_match_touch_evict():
+    """Content-addressed reuse: chain-keyed full blocks are matchable while
+    referenced or evictable, revivable via touch, and evicted LRU-first —
+    never while refcounted."""
+    alloc = BlockAllocator(num_blocks=8, block_size=4)  # 7 usable
+    ids = list(range(12))  # 3 full blocks
+    hashes = prefix_block_hashes(ids, 4)
+    assert len(hashes) == 3
+    blocks = alloc.allocate(3)
+    for b, h in zip(blocks, hashes):
+        assert alloc.register(b, h)
+    assert alloc.match_prefix(hashes) == blocks
+    # A divergent token stream matches only the shared block-prefix; the
+    # chain key makes equal block contents at different depths distinct.
+    diverged = prefix_block_hashes(ids[:8] + [7, 7, 7, 7], 4)
+    assert alloc.match_prefix(diverged) == blocks[:2]
+    assert prefix_block_hashes([9] * 8, 4)[1] != prefix_block_hashes(
+        [9] * 4, 4
+    )[0]
+    alloc.free(blocks)
+    # Freed-but-keyed blocks park evictable: content reusable, space
+    # reclaimable.
+    assert alloc.num_allocated == 0 and alloc.num_evictable == 3
+    assert alloc.num_free == 7
+    m = alloc.match_prefix(hashes)
+    assert m == blocks
+    alloc.touch(m)  # revive from the evictable pool
+    assert alloc.num_evictable == 0 and alloc.refcount(m[0]) == 1
+    alloc.touch([m[0]])  # shared: refcount, not copy
+    assert alloc.refcount(m[0]) == 2
+    alloc.free(m)
+    assert alloc.refcount(m[0]) == 1  # still held by the second ref
+    alloc.free([m[0]])
+    assert alloc.num_evictable == 3
+    # Pressure: the plain free list (4 blocks) is drained first...
+    hot = alloc.allocate(4)
+    assert alloc.num_evictable == 3
+    # ...then evictable blocks are reclaimed in LRU order — blocks[0] held
+    # its extra ref longest, so it was freed last and evicts last — and
+    # eviction drops their keys; refcounted blocks are never handed out.
+    assert alloc.allocate(3) == [blocks[1], blocks[2], blocks[0]]
+    assert alloc.num_evictable == 0 and alloc.match_prefix(hashes) == []
+    assert alloc.num_evictions == 3
+    with pytest.raises(CacheOutOfBlocks):
+        alloc.allocate(1)
+    assert set(hot) & set(blocks) == set()
+
+
+def test_allocator_eviction_policy_knobs():
+    with pytest.raises(ValueError, match="eviction_policy"):
+        BlockAllocator(4, 4, eviction_policy="bogus")
+    with pytest.raises(ValueError, match="prefix_eviction_policy"):
+        EngineConfig(prefix_eviction_policy="bogus")
+    # FIFO evicts by registration order even when a block was recently
+    # used; LRU (the default, exercised above) evicts least-recently-freed.
+    alloc = BlockAllocator(num_blocks=6, block_size=4, eviction_policy="fifo")
+    a = alloc.allocate(2)
+    h = prefix_block_hashes(list(range(8)), 4)
+    alloc.register(a[0], h[0])
+    alloc.register(a[1], h[1])
+    alloc.free(a)
+    alloc.touch([a[0]])  # re-use a[0]: LRU would now evict a[1] first
+    alloc.free([a[0]])
+    alloc.allocate(3)  # drain the plain free list
+    assert alloc.allocate(1) == [a[0]]  # FIFO: first registered goes first
 
 
 def test_engine_config_buckets():
@@ -184,6 +265,34 @@ def test_paged_attention_matches_dense():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(want), atol=1e-5
     )
+
+
+def test_paged_attention_partial_prefill_matches_dense():
+    """Multi-token queries (prefix-aware partial prefill): paged attention
+    over the cached prefix plus a causal mask among the new tokens must
+    equal per-position dense attention over the growing sequence."""
+    rng = np.random.RandomState(1)
+    bs, nblocks, nb, h, d = 4, 12, 3, 2, 8
+    ctx, s_new = 8, 3  # 8 cached prefix tokens (2 blocks), 3 suffix tokens
+    k_cache = jnp.asarray(rng.randn(nblocks, bs, h, d), jnp.float32)
+    v_cache = jnp.asarray(rng.randn(nblocks, bs, h, d), jnp.float32)
+    q = jnp.asarray(rng.randn(1, s_new, h, d), jnp.float32)
+    new_k = jnp.asarray(rng.randn(1, s_new, h, d), jnp.float32)
+    new_v = jnp.asarray(rng.randn(1, s_new, h, d), jnp.float32)
+    table = jnp.asarray([[5, 2, 0]], jnp.int32)  # padded past the prefix
+    out = paged_attention(
+        q, k_cache, v_cache, table, jnp.asarray([ctx], jnp.int32),
+        new_k=new_k, new_v=new_v,
+    )
+    k_seq = k_cache[table[0]].reshape(1, nb * bs, h, d)[:, :ctx]
+    v_seq = v_cache[table[0]].reshape(1, nb * bs, h, d)[:, :ctx]
+    for i in range(s_new):
+        k_full = jnp.concatenate([k_seq, new_k[:, : i + 1]], axis=1)
+        v_full = jnp.concatenate([v_seq, new_v[:, : i + 1]], axis=1)
+        want = mha_reference(q[:, i : i + 1], k_full, v_full)
+        np.testing.assert_allclose(
+            np.asarray(out[:, i : i + 1]), np.asarray(want), atol=1e-5
+        )
 
 
 # ---------------- engine end-to-end ----------------
@@ -319,6 +428,100 @@ def test_engine_abort_releases_blocks(tiny_engine):
     assert eng.allocator.num_allocated == 0
     assert not eng.has_work()
     assert not eng.abort("nonexistent")
+
+
+def test_engine_prefix_cache_hit_on_repeated_prompt(tiny_engine):
+    """A repeated prompt's full blocks are served from the prefix cache
+    (only the tail is recomputed) with identical greedy output, and the
+    hit/evictable metric series are exported."""
+    eng = tiny_engine
+    prompt = random_prompts((20,), seed=11)[0]
+    out1 = eng.generate([prompt], max_new_tokens=6)[0]
+    hits_before = eng.stats()["prefix_cache_hit_tokens"]
+    out2 = eng.generate([prompt], max_new_tokens=6)[0]
+    assert out2 == out1
+    stats = eng.stats()
+    # 20-token prompt = 2 full blocks (16 tokens) cached + 4-token tail.
+    assert stats["prefix_cache_hit_tokens"] - hits_before == 16
+    assert 0 < stats["prefix_cache_hit_rate"] < 1
+    assert stats["evictable_blocks"] > 0  # finished seqs stay cached
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    for name in (
+        "llm_engine_prefix_cache_hit_tokens",
+        "llm_engine_prefix_cache_hit_rate",
+        "llm_engine_evictable_blocks",
+        "llm_engine_preemptions",
+    ):
+        assert name in text
+
+
+def test_engine_abort_waiting_never_admitted_sequence(tiny_engine):
+    eng = tiny_engine
+    allocated_before = eng.allocator.num_allocated
+    rid = eng.add_request(random_prompts((9,), seed=12)[0], max_new_tokens=4)
+    assert eng.abort(rid)  # still waiting: no blocks were ever mapped
+    assert eng.allocator.num_allocated == allocated_before
+    assert not eng.has_work()
+    assert not eng.abort(rid)
+
+
+def test_engine_cow_divergence_on_shared_prefix_block(tiny_engine):
+    """Two live sequences share a fully-cached prompt: the second one's
+    re-prefill copy-on-writes the last shared block (its final-token K/V
+    write would otherwise corrupt the first sequence's cache), then the
+    two diverge into private tails."""
+    eng = tiny_engine
+    prompt = random_prompts((16,), seed=13)[0]  # exactly 2 full blocks
+    a_toks, b_toks = [], []
+    eng.add_request(prompt, max_new_tokens=8, on_token=a_toks.append)
+    eng.step()  # A prefills; its two full blocks are published
+    seq_a = eng.scheduler.running[0]
+    table_a = list(seq_a.block_table)
+    cows_before = eng.scheduler.num_cow_blocks
+    eng.add_request(prompt, max_new_tokens=3, on_token=b_toks.append)
+    eng.step()  # B admits fully-cached: shares block 0, CoWs block 1
+    seq_b = eng.scheduler.running[-1]
+    assert seq_b is not seq_a
+    assert eng.scheduler.num_cow_blocks == cows_before + 1
+    assert seq_b.block_table[0] == table_a[0]  # shared, refcounted
+    assert eng.allocator.refcount(table_a[0]) == 2
+    assert seq_b.block_table[1] != table_a[1]  # private CoW copy
+    while eng.has_work():
+        eng.step()
+    # B's writes never touched A's blocks: both continuations are the
+    # unbatched ground truth (B's is a prefix of A's — same prompt).
+    ref = reference_greedy(GPT(TINY), eng.runner.params, prompt, 8)
+    assert a_toks == ref
+    assert b_toks == ref[:3]
+
+
+def test_engine_preempt_resume_hits_prefix_cache_and_matches_uncached():
+    """Acceptance: a mixed prefill/decode/preemption workload is
+    token-identical with prefix caching on and off — and with caching on,
+    a preempted victim's resume re-prefill hits its own still-cached
+    blocks instead of recomputing from token 0."""
+    kw = dict(
+        block_size=4, num_blocks=10, max_decode_slots=4, max_blocks_per_seq=8
+    )
+    prompts = random_prompts((6, 7, 5, 6), seed=1)
+    cached = LLMEngine(
+        TINY, EngineConfig(**kw, enable_prefix_caching=True), seed=0
+    )
+    outs_cached = cached.generate(prompts, max_new_tokens=12)
+    stats = cached.stats()
+    assert stats["num_preemptions"] > 0
+    assert stats["prefix_cache_hit_tokens"] > 0  # resumes reused blocks
+    assert cached.allocator.num_allocated == 0
+    uncached = LLMEngine(
+        TINY, EngineConfig(**kw, enable_prefix_caching=False), seed=0
+    )
+    outs_uncached = uncached.generate(prompts, max_new_tokens=12)
+    assert uncached.stats()["num_preemptions"] > 0
+    assert uncached.stats()["prefix_cache_hit_tokens"] == 0
+    assert uncached.stats()["evictable_blocks"] == 0
+    assert outs_cached == outs_uncached
 
 
 def test_llm_server_warmup_respects_admission_limits():
