@@ -450,6 +450,85 @@ def bench_serving_prefix_cache():
     report("serving_prefix_throughput_speedup", tps_on / tps_off, unit="x")
 
 
+def bench_serving_failover():
+    """Cost of a mid-stream replica failover: p50/p99 latency ADDED to a
+    streaming LLM request when the replica serving it dies halfway through
+    (deterministic fault injection raises ActorDiedError between yields)
+    and the router resumes on the second replica via llm_stream_resume.
+
+    The resume re-submits prompt + tokens-received-so-far, so with prefix
+    caching the resumed prefill is mostly cache hits — the added latency is
+    roughly one retry backoff (50ms default) plus one tail prefill."""
+    import jax.numpy as jnp
+
+    from ray_tpu import serve
+    from ray_tpu._private import fault_injection as fi
+    from ray_tpu.exceptions import ActorDiedError
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(
+        vocab_size=512, num_layers=2, num_heads=4, embed_dim=128,
+        max_seq_len=256, dtype=jnp.float32, attention_impl="reference",
+    )
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=128, max_decode_slots=8,
+        max_blocks_per_seq=8, prefill_buckets=(16, 64),
+    )
+    handle = serve.run(
+        build_app(cfg, ecfg, engine_name="bench-failover", num_replicas=2),
+        name="bench-failover",
+    )
+    stream_handle = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    )
+    rng = np.random.RandomState(0)
+    n_new = 24
+    prompts = [
+        list(map(int, rng.randint(0, 512, size=12))) for _ in range(12)
+    ]
+
+    def stream_once(prompt) -> float:
+        t0 = time.perf_counter()
+        tokens = [
+            d["token_id"]
+            for d in stream_handle.remote(
+                {"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True}
+            )
+        ]
+        assert len(tokens) == n_new  # contiguous through any failover
+        return time.perf_counter() - t0
+
+    for p in prompts[:2]:  # warm both replicas' paths
+        stream_once(p)
+    base = sorted(stream_once(p) for p in prompts)
+    killed = []
+    for p in prompts:
+        # Fresh spec per request: die after delivering half the tokens.
+        spec = fi.inject(
+            "replica.stream_item",
+            nth=n_new // 2,
+            exc_factory=lambda: ActorDiedError(None, "bench mid-stream kill"),
+        )
+        try:
+            killed.append(stream_once(p))
+            assert spec.fires == 1
+        finally:
+            fi.remove(spec)
+    killed.sort()
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    base_p50 = pct(base, 0.5)
+    added = sorted(k - base_p50 for k in killed)
+    report("serving_failover_stream_base_p50", 1e3 * base_p50, unit="ms")
+    report("serving_failover_added_latency_p50", 1e3 * pct(added, 0.5), unit="ms")
+    report("serving_failover_added_latency_p99", 1e3 * pct(added, 0.99), unit="ms")
+    serve.shutdown()
+
+
 ALL = [
     ("single_client_tasks_sync", bench_tasks_sync),
     ("single_client_tasks_async", bench_tasks_async),
@@ -508,6 +587,7 @@ ALL = [
     ("train_ingestion", bench_train_ingestion),
     ("serving_decode", bench_serving_decode),
     ("serving_prefix_cache", bench_serving_prefix_cache),
+    ("serving_failover", bench_serving_failover),
 ]
 
 

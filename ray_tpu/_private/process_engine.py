@@ -250,7 +250,11 @@ class WirePeer:
             return None
         if method == "cancel":
             ref = ObjectRef(ObjectID(payload["oid"]))
-            return runtime.cancel(ref, force=payload.get("force", False))
+            return runtime.cancel(
+                ref,
+                force=payload.get("force", False),
+                recursive=payload.get("recursive", False),
+            )
         if method == "get_logs":
             return {
                 "rows": runtime.logs.tail(

@@ -38,6 +38,7 @@ from ray_tpu._private.controller import (
     NodeState,
 )
 from ray_tpu._private.engine import CONTEXT, ActorExecutor, NodeEngine, TaskResult
+from ray_tpu._private.fault_injection import maybe_fail
 from ray_tpu._private.ids import (
     ActorID,
     JobID,
@@ -54,6 +55,7 @@ from ray_tpu._private.task_spec import TaskKind, TaskSpec
 from ray_tpu.exceptions import (
     ActorDiedError,
     ObjectLostError,
+    PoisonRequestError,
     TaskCancelledError,
     TaskError,
 )
@@ -83,21 +85,7 @@ class ErrorObject:
 def _as_instanceof_cause(err: TaskError) -> BaseException:
     """Build `TaskError(CauseType)` so `except CauseType` works at the call site
     (reference: RayTaskError.as_instanceof_cause, python/ray/exceptions.py)."""
-    cause = err.cause
-    if isinstance(cause, TaskError):
-        return cause
-    cause_cls = type(cause)
-    try:
-        derived = type(
-            f"TaskError({cause_cls.__name__})",
-            (TaskError, cause_cls),
-            {"__module__": "ray_tpu.exceptions"},
-        )
-        instance = derived.__new__(derived)
-        TaskError.__init__(instance, cause, err.traceback_str, err.task_name)
-        return instance
-    except TypeError:
-        return err
+    return err.as_instanceof_cause()
 
 
 def _capture_trace() -> Optional[tuple]:
@@ -782,7 +770,14 @@ class Runtime:
         if error is not None:
             exc = error
             if not isinstance(
-                exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)
+                exc,
+                (
+                    TaskError,
+                    ActorDiedError,
+                    ObjectLostError,
+                    TaskCancelledError,
+                    PoisonRequestError,
+                ),
             ):
                 exc = TaskError(exc, traceback_str, spec.name)
             self.store.seal(oid, ErrorObject(exc, traceback_str))
@@ -801,7 +796,14 @@ class Runtime:
             # surface it as the stream's last item so iteration raises.
             exc = result.exc
             if not isinstance(
-                exc, (TaskError, ActorDiedError, ObjectLostError, TaskCancelledError)
+                exc,
+                (
+                    TaskError,
+                    ActorDiedError,
+                    ObjectLostError,
+                    TaskCancelledError,
+                    PoisonRequestError,
+                ),
             ):
                 exc = TaskError(exc, result.traceback_str, spec.name)
             oid = ObjectID.of(spec.task_id, _STREAM_INDEX_OFFSET + _STREAM_ERROR_INDEX)
@@ -931,6 +933,7 @@ class Runtime:
         num_returns: int,
         trace_ctx: Optional[tuple] = None,
     ) -> list[ObjectRef]:
+        maybe_fail("actor.submit", detail=name)
         record = self.controller.get_actor_record(actor_id)
         if record is None:
             raise ValueError(f"Unknown actor {actor_id}")
@@ -1108,8 +1111,38 @@ class Runtime:
 
     # --------------------------------------------------------------- cancel
 
-    def cancel(self, ref: ObjectRef, force: bool = False) -> bool:
-        task_id = ref.id.task_id
+    def cancel(
+        self, ref: ObjectRef, force: bool = False, recursive: bool = False
+    ) -> bool:
+        return self._cancel_task(ref.id.task_id, force=force, recursive=recursive)
+
+    def _cancel_task(
+        self,
+        task_id,
+        *,
+        force: bool = False,
+        recursive: bool = False,
+        _seen: Optional[set] = None,
+    ) -> bool:
+        if _seen is None:
+            _seen = set()
+        if task_id in _seen:
+            return False
+        _seen.add(task_id)
+        if recursive:
+            # Cancel tasks submitted BY this task first (reference: ray.cancel
+            # recursive=True cancels the whole descendant tree). Finished
+            # children are no-ops below.
+            with self._lock:
+                children = [
+                    tid
+                    for tid, rec in self._task_records.items()
+                    if rec.spec.parent_task_id == task_id and tid not in _seen
+                ]
+            for child in children:
+                self._cancel_task(
+                    child, force=force, recursive=True, _seen=_seen
+                )
         if self.scheduler.cancel(task_id):
             with self._lock:
                 record = self._task_records.get(task_id)
@@ -1359,6 +1392,7 @@ class Runtime:
                         ObjectLostError,
                         TaskCancelledError,
                         WorkerCrashedError,
+                        PoisonRequestError,
                     ),
                 ):
                     exc = TaskError(exc, result.traceback_str, spec.name)

@@ -399,8 +399,11 @@ class WorkerProxyRuntime:
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self.rpc("kill_actor", {"actor_id": actor_id.binary(), "no_restart": no_restart})
 
-    def cancel(self, ref, force: bool = False) -> bool:
-        return self.rpc("cancel", {"oid": ref.id.binary(), "force": force})
+    def cancel(self, ref, force: bool = False, recursive: bool = False) -> bool:
+        return self.rpc(
+            "cancel",
+            {"oid": ref.id.binary(), "force": force, "recursive": recursive},
+        )
 
     def report_stream_item(
         self, spec: TaskSpec, index: int, value=None, error=None, traceback_str=""

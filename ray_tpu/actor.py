@@ -12,10 +12,17 @@ from ray_tpu._private.runtime import get_runtime
 
 
 class ActorMethod:
-    def __init__(self, actor_handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        actor_handle: "ActorHandle",
+        method_name: str,
+        num_returns: int = 1,
+        name: str | None = None,
+    ):
         self._handle = actor_handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._name = name  # display name for the submitted task
 
     def options(
         self, num_returns: int | None = None, name: str | None = None
@@ -24,6 +31,7 @@ class ActorMethod:
             self._handle,
             self._method_name,
             self._num_returns if num_returns is None else num_returns,
+            self._name if name is None else name,
         )
 
     def remote(self, *args, **kwargs):
@@ -33,7 +41,8 @@ class ActorMethod:
             self._method_name,
             args,
             kwargs,
-            name=f"{self._handle._class_name}.{self._method_name}",
+            name=self._name
+            or f"{self._handle._class_name}.{self._method_name}",
             num_returns=self._num_returns,
         )
         if self._num_returns == 0:

@@ -185,7 +185,7 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
-    get_runtime().cancel(ref, force=force)
+    get_runtime().cancel(ref, force=force, recursive=recursive)
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

@@ -22,8 +22,62 @@ class TaskError(RayTpuError):
         super().__init__(f"Task {task_name or '<unknown>'} failed: {cause!r}\n{traceback_str}")
 
     def as_instanceof_cause(self) -> BaseException:
-        """Return an exception that is an instance of the cause's class."""
-        return self
+        """Return an exception that is an instance of the cause's class, so
+        `except ValueError:` at the call site catches a remote ValueError
+        (reference: RayTaskError.as_instanceof_cause). Built as a dynamic
+        subclass of both TaskError and the cause's class; falls back to
+        self when the cause's class cannot be subclassed (e.g. BaseException
+        subclasses with incompatible layouts)."""
+        cause = self.cause
+        if isinstance(cause, TaskError):
+            return cause
+        cause_cls = type(cause)
+        if isinstance(self, cause_cls):
+            return self
+        try:
+            derived = type(
+                f"TaskError({cause_cls.__name__})",
+                (TaskError, cause_cls),
+                {"__module__": "ray_tpu.exceptions"},
+            )
+            # Assemble the instance WITHOUT running __init__: on the diamond
+            # class, TaskError.__init__'s super().__init__(message) would
+            # dispatch to the cause class's __init__ with the message string,
+            # clobbering its payload (e.g. PoisonRequestError.request_id).
+            instance = derived.__new__(derived)
+            instance.args = (str(self),)
+            # Carry the cause's payload so `except CauseType as e:` sees the
+            # same attributes as a local raise — except the fields TaskError
+            # itself owns, which keep wrapper semantics (cause = the remote
+            # exception) so chained wrap/unwrap hops stay type-stable.
+            for key, value in vars(cause).items():
+                if key not in ("cause", "traceback_str", "task_name"):
+                    instance.__dict__[key] = value
+            instance.cause = cause
+            instance.traceback_str = self.traceback_str
+            instance.task_name = self.task_name
+            return instance
+        except TypeError:
+            return self
+
+    def __reduce__(self):
+        # Exceptions cross the object store by pickle, and the default
+        # reduce calls cls(args[0]) — wrong for this signature, and
+        # impossible for the dynamic TaskError(CauseType) subclasses (their
+        # class doesn't exist on the other side). Rebuild from the payload
+        # instead (reference: RayTaskError's dual-exception machinery).
+        if type(self) is TaskError:
+            return (TaskError, (self.cause, self.traceback_str, self.task_name))
+        return (
+            _rebuild_derived_task_error,
+            (self.cause, self.traceback_str, self.task_name),
+        )
+
+
+def _rebuild_derived_task_error(
+    cause: BaseException, traceback_str: str, task_name: str
+) -> BaseException:
+    return TaskError(cause, traceback_str, task_name).as_instanceof_cause()
 
 
 class ActorError(RayTpuError):
@@ -35,15 +89,77 @@ class ActorDiedError(ActorError):
         self.actor_id = actor_id
         super().__init__(reason)
 
+    def __reduce__(self):
+        # Default reduce would call ActorDiedError(message), silently
+        # shifting the reason into actor_id on every store round-trip.
+        return (ActorDiedError, (self.actor_id, str(self)))
+
 
 class ActorUnavailableError(ActorError):
     pass
+
+
+class ReplicaUnavailableRetryExhausted(ActorError):
+    """The Serve router's client-side failover gave up: every dispatch of a
+    request within its retry budget landed on a dead/unavailable replica.
+    Carries the attempt count and the last underlying error so callers see
+    a typed failure instead of a raw ActorDiedError."""
+
+    def __init__(
+        self,
+        deployment: str = "",
+        attempts: int = 0,
+        last_error: "BaseException | None" = None,
+    ):
+        self.deployment = deployment
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"request to deployment {deployment!r} failed after {attempts} "
+            f"dispatch attempt(s); last error: {last_error!r}"
+        )
+
+    def __reduce__(self):
+        return (
+            ReplicaUnavailableRetryExhausted,
+            (self.deployment, self.attempts, self.last_error),
+        )
+
+
+class PoisonRequestError(RayTpuError):
+    """One serving request caused an engine step exception and was failed in
+    isolation (dead-lettered); the engine itself kept serving the other
+    in-flight requests. `request_id` identifies the culprit and `cause` is
+    the original step exception."""
+
+    def __init__(
+        self,
+        request_id: str = "",
+        reason: str = "",
+        cause: "BaseException | None" = None,
+    ):
+        self.request_id = request_id
+        self.reason = reason
+        self.cause = cause
+        super().__init__(
+            f"request {request_id or '<unknown>'} poisoned the engine step: "
+            f"{reason or cause!r}"
+        )
+
+    def __reduce__(self):
+        return (
+            PoisonRequestError,
+            (self.request_id, self.reason, self.cause),
+        )
 
 
 class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
 
 
 class WorkerCrashedError(RayTpuError):
@@ -63,6 +179,9 @@ class ObjectLostError(RayTpuError):
     def __init__(self, object_id=None, reason: str = "object lost"):
         self.object_id = object_id
         super().__init__(reason)
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, str(self)))
 
 
 class ObjectFreedError(ObjectLostError):

@@ -26,6 +26,7 @@ from ray_tpu.llm.model_runner import GPTRunner
 from ray_tpu.llm.scheduler import (
     FINISH_ABORTED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     Request,
     Scheduler,
@@ -39,6 +40,7 @@ __all__ = [
     "EngineConfig",
     "FINISH_ABORTED",
     "FINISH_EOS",
+    "FINISH_ERROR",
     "FINISH_LENGTH",
     "GPTRunner",
     "LLMEngine",

@@ -42,6 +42,16 @@ class EngineConfig:
     # Which cached-but-unreferenced block to evict under pressure:
     # "lru" (least recently freed/used) or "fifo" (oldest registration).
     prefix_eviction_policy: str = "lru"
+    # Poison-request isolation: a step exception attributable to a single
+    # request dead-letters only that request (its KV blocks are released
+    # and the loop keeps stepping; an isolated failure does not count
+    # toward the threshold below). After this many CONSECUTIVE failing
+    # steps with no isolatable culprit the engine declares itself wedged:
+    # check_health() flips false and the error is broadcast to every
+    # waiter so the Serve controller replaces the replica.
+    max_consecutive_step_failures: int = 3
+    # How many dead-letter records (id, prompt hash, error) to retain.
+    dead_letter_capacity: int = 64
 
     @property
     def max_model_len(self) -> int:
@@ -68,6 +78,10 @@ class EngineConfig:
             raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
         if self.max_decode_slots < 1:
             raise ValueError("max_decode_slots must be >= 1")
+        if self.max_consecutive_step_failures < 1:
+            raise ValueError("max_consecutive_step_failures must be >= 1")
+        if self.dead_letter_capacity < 1:
+            raise ValueError("dead_letter_capacity must be >= 1")
         from ray_tpu.llm.cache import EVICTION_POLICIES
 
         if self.prefix_eviction_policy not in EVICTION_POLICIES:

@@ -13,19 +13,24 @@ standard Prometheus registry.
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private.fault_injection import maybe_fail
+from ray_tpu.exceptions import PoisonRequestError
 from ray_tpu.llm.cache import BlockAllocator, blocks_for_tokens
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.llm.model_runner import GPTRunner
 from ray_tpu.llm.scheduler import (
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     Request,
     Scheduler,
@@ -116,6 +121,20 @@ class LLMEngine:
             "Cached-but-unreferenced KV blocks (reusable until evicted)",
             tag_keys=("engine",),
         )
+        self._dead_letter_count = get_or_create(
+            Counter,
+            "llm_engine_dead_letter_requests",
+            "Requests failed in isolation after poisoning an engine step",
+            tag_keys=("engine",),
+        )
+        # Poison-request isolation: records of requests failed in isolation
+        # after an attributable step exception, newest last.
+        self._dead_letters: deque = deque(
+            maxlen=self.engine_config.dead_letter_capacity
+        )
+        # Request whose per-sequence section of step() is currently running;
+        # a step exception raised there is attributed to it.
+        self._current_rid: Optional[str] = None
         self._steps = 0
         self._decode_tokens = 0
         self._decode_slot_steps = 0
@@ -192,6 +211,49 @@ class LLMEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_work()
 
+    # ---------------- poison-request isolation ----------------
+
+    def culprit_for(self, exc: BaseException) -> Optional[str]:
+        """Which active request a step exception is attributable to: the
+        exception's own request_id (PoisonRequestError and injected faults
+        carry one) or the request whose per-sequence section of step() was
+        running. None when the failure can't be pinned on one request."""
+        rid = getattr(exc, "request_id", None) or self._current_rid
+        if rid and self.scheduler.is_active(rid):
+            return rid
+        return None
+
+    def fail_request(self, request_id: str, exc: BaseException) -> bool:
+        """Fail one request in isolation: release its KV blocks, record a
+        dead letter, and fire its finish callback (finish_reason="error").
+        Returns False when the request is not active."""
+        seq = self.scheduler.abort(request_id)
+        if seq is None:
+            return False
+        seq.finish_reason = FINISH_ERROR
+        prompt = seq.request.prompt_ids
+        self._dead_letters.append(
+            {
+                "request_id": request_id,
+                "prompt_hash": hashlib.sha1(
+                    ",".join(map(str, prompt)).encode()
+                ).hexdigest()[:16],
+                "prompt_len": len(prompt),
+                "tokens_generated": len(seq.generated),
+                "error": repr(exc),
+                "step": self._steps,
+                "time": time.time(),
+            }
+        )
+        self._dead_letter_count.inc(tags=self._metric_tags)
+        self._finished(seq)
+        return True
+
+    def dead_letters(self) -> List[dict]:
+        """Records of requests failed in isolation, oldest first (bounded
+        by EngineConfig.dead_letter_capacity)."""
+        return list(self._dead_letters)
+
     # ---------------- stepping ----------------
 
     def step(self) -> dict:
@@ -200,30 +262,24 @@ class LLMEngine:
         ecfg = self.engine_config
         preempted_before = self.scheduler.num_preemptions
         step_hit_tokens = 0
+        self._current_rid = None
+        maybe_fail("llm.step")
 
         admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
-        for seq in admitted:
-            offset = seq.num_cached  # tokens the admission matched in-cache
-            if seq.pending_copy is not None:
-                # Copy-on-write: the last matched block is shared and this
-                # prefill writes its final token's K/V into it.
-                src, dst = seq.pending_copy
-                seq.pending_copy = None
-                self.runner.copy_block(src, dst)
-                self.allocator.free([src])  # drop admission's copy-source ref
-            if offset > 0:
-                first = self.runner.prefill_suffix(
-                    seq.prefill_ids[offset:], seq.block_table, offset
-                )
-                step_hit_tokens += offset
-            else:
-                first = self.runner.prefill(seq.prefill_ids, seq.block_table)
-            self._prefill_tokens += len(seq.prefill_ids)
-            seq.num_cached = len(seq.prefill_ids)
-            self.scheduler.note_filled_blocks(seq)
-            seq.generated.append(first)
-            self._emit(seq)
-            self._maybe_finish(seq)
+        try:
+            step_hit_tokens += self._run_prefills(admitted)
+        except BaseException:
+            # A failed prefill must not leave admitted-but-never-prefilled
+            # sequences in the running set (they would decode from K/V that
+            # was never computed): requeue them recompute-style. The culprit
+            # itself is requeued too — the caller either fails it
+            # (fail_request pulls it from waiting) or retries the step,
+            # which re-admits and re-prefills it. Reversed so the chain of
+            # appendleft()s lands them back in arrival order (FIFO fairness).
+            for seq in reversed(admitted):
+                if seq.is_running and seq.num_cached < len(seq.prefill_ids):
+                    self.scheduler.preempt(seq)
+            raise
 
         decoding = self.scheduler.schedule_decode()
         if decoding:
@@ -242,6 +298,12 @@ class LLMEngine:
                 tokens, positions, block_tables, context_lens
             )
             for i, seq in enumerate(decoding):
+                # Per-sequence section; placed before any mutation so a
+                # failure here leaves this sequence (and every later one,
+                # whose decode simply re-runs from unchanged state next
+                # step) consistent.
+                self._current_rid = seq.request.request_id
+                maybe_fail("llm.decode.seq", detail=seq.request.request_id)
                 seq.num_cached += 1
                 seq.generated.append(int(next_tokens[i]))
                 if seq.num_cached % ecfg.block_size == 0:
@@ -250,6 +312,7 @@ class LLMEngine:
                     self.scheduler.note_filled_blocks(seq)
                 self._emit(seq)
                 self._maybe_finish(seq)
+            self._current_rid = None
             self._decode_tokens += len(decoding)
             self._decode_slot_steps += ecfg.max_decode_slots
 
@@ -281,6 +344,39 @@ class LLMEngine:
             "cache_hit_tokens": step_hit_tokens,
             "evictable_blocks": self.allocator.num_evictable,
         }
+
+    def _run_prefills(self, admitted: List[Sequence]) -> int:
+        """Run the prefill for each just-admitted sequence; returns the
+        prompt tokens served from the prefix cache this step."""
+        hit_tokens = 0
+        for seq in admitted:
+            # Per-sequence section: an exception below is attributable to
+            # this request (LLMServer._loop fails only it and keeps going).
+            self._current_rid = seq.request.request_id
+            maybe_fail("llm.prefill", detail=seq.request.request_id)
+            offset = seq.num_cached  # tokens the admission matched in-cache
+            if seq.pending_copy is not None:
+                # Copy-on-write: the last matched block is shared and this
+                # prefill writes its final token's K/V into it.
+                src, dst = seq.pending_copy
+                seq.pending_copy = None
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])  # drop admission's copy-source ref
+            if offset > 0:
+                first = self.runner.prefill_suffix(
+                    seq.prefill_ids[offset:], seq.block_table, offset
+                )
+                hit_tokens += offset
+            else:
+                first = self.runner.prefill(seq.prefill_ids, seq.block_table)
+            self._prefill_tokens += len(seq.prefill_ids)
+            seq.num_cached = len(seq.prefill_ids)
+            self.scheduler.note_filled_blocks(seq)
+            seq.generated.append(first)
+            self._emit(seq)
+            self._maybe_finish(seq)
+        self._current_rid = None
+        return hit_tokens
 
     def _emit(self, seq: Sequence) -> None:
         cb = self._on_token.get(seq.request.request_id)
@@ -356,6 +452,7 @@ class LLMEngine:
             "evictable_blocks": self.allocator.num_evictable,
             "prefix_cache_evictions": self.allocator.num_evictions,
             "cow_blocks": self.scheduler.num_cow_blocks,
+            "num_dead_letters": len(self._dead_letters),
             "uptime_s": elapsed,
         }
 
@@ -448,6 +545,8 @@ class LLMServer:
         self._work = threading.Condition(self._lock)
         self._requests: Dict[str, _RequestState] = {}
         self._shutdown = False
+        self._wedged = False
+        self._consecutive_step_failures = 0
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine-loop", daemon=True
         )
@@ -456,6 +555,7 @@ class LLMServer:
     # ---------------- engine loop ----------------
 
     def _loop(self) -> None:
+        max_failures = self._engine.engine_config.max_consecutive_step_failures
         while True:
             with self._work:
                 while not self._shutdown and not self._engine.has_work():
@@ -467,17 +567,58 @@ class LLMServer:
             with self._lock:
                 try:
                     self._engine.step()
-                except BaseException as exc:  # surface to every waiter
-                    # Flag the crash while still holding the lock so no
-                    # submission can slip in between the error broadcast
-                    # and the thread actually dying.
+                    self._consecutive_step_failures = 0
+                    continue
+                except BaseException as exc:
+                    self._consecutive_step_failures += 1
+                    # Attribution comes FIRST: an isolatable poison request
+                    # must be dead-lettered even when the consecutive-
+                    # failure counter is at the threshold (otherwise
+                    # max_consecutive_step_failures=1 would disable
+                    # isolation entirely).
+                    culprit = self._engine.culprit_for(exc)
+                    if culprit is not None:
+                        # Poison-request isolation: fail only the culpable
+                        # request (dead-letter + KV release) and keep
+                        # stepping for everyone else. The waiter's error is
+                        # set BEFORE fail_request fires its finish callback
+                        # so the caller never sees a clean finish.
+                        state = self._requests.get(culprit)
+                        if state is not None and not state.done.is_set():
+                            state.error = PoisonRequestError(
+                                request_id=culprit, cause=exc
+                            )
+                        if self._engine.fail_request(culprit, exc):
+                            # Contained: the culprit is out of the batch, so
+                            # the engine is making progress — only steps
+                            # that fail WITHOUT an isolatable culprit count
+                            # toward the wedge threshold (a stream of poison
+                            # requests must not take down the replica).
+                            self._consecutive_step_failures = 0
+                        continue
+                    if self._consecutive_step_failures < max_failures:
+                        # Unattributable failure (e.g. the batched decode
+                        # program itself): per-sequence state only mutates
+                        # after the risky calls return, so retrying the
+                        # step is safe. A deterministic failure trips the
+                        # consecutive-failures threshold and wedges below.
+                        continue
+                    # Wedged: broadcast to every waiter while still holding
+                    # the lock so no submission can slip in between the
+                    # error broadcast and the thread actually dying; the
+                    # Serve controller's next health probe then replaces
+                    # the replica.
+                    self._wedged = True
                     self._shutdown = True
                     for state in self._requests.values():
                         if not state.done.is_set():
                             state.error = exc
                             state.tokens.put(_STREAM_END)
                             state.done.set()
-                    raise
+                    import traceback
+
+                    traceback.print_exc()
+                    return
 
     def _submit(
         self,
@@ -589,7 +730,16 @@ class LLMServer:
 
     def metrics(self) -> dict:
         with self._lock:
-            return self._engine.stats()
+            stats = self._engine.stats()
+            stats["wedged"] = self._wedged
+            stats["consecutive_step_failures"] = self._consecutive_step_failures
+            return stats
+
+    def dead_letters(self) -> List[dict]:
+        """Records of requests failed in isolation after poisoning an
+        engine step (id, prompt hash, error, step), oldest first."""
+        with self._lock:
+            return self._engine.dead_letters()
 
     def reset_prefix_cache(self) -> None:
         """Drop all cached-but-unreferenced KV blocks (e.g. after swapping
@@ -604,7 +754,7 @@ class LLMServer:
             )
 
     def check_health(self) -> bool:
-        return self._thread.is_alive()
+        return self._thread.is_alive() and not self._wedged
 
     def shutdown(self) -> None:
         with self._work:

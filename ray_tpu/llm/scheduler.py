@@ -36,6 +36,7 @@ from ray_tpu.llm.cache import (
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 FINISH_ABORTED = "aborted"
+FINISH_ERROR = "error"  # dead-lettered after poisoning an engine step
 
 _arrival = itertools.count()
 

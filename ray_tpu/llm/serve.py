@@ -38,6 +38,39 @@ def get_or_create_engine_actor(
     )
 
 
+def llm_stream_resume(args: tuple, kwargs: dict, items: list):
+    """Failover resume policy for LLMIngress token streams (pass as
+    `handle.options(stream=True, stream_resume_fn=llm_stream_resume)`).
+
+    When a replica dies mid-stream, the router re-submits the request with
+    the token ids the client has already received folded into the prompt,
+    so the resumed stream continues exactly where the dead replica stopped
+    and the client-visible stream stays contiguous. With prefix caching the
+    resumed prefill is mostly cache hits, so a mid-stream failover costs
+    roughly one tail-block prefill. Greedy decoding makes the resumed
+    continuation token-identical (the same mechanism as recompute-style
+    preemption). Returns None when the stream was already complete.
+
+    Note: resuming computes the remaining budget from the request's own
+    "max_new_tokens"; requests that rely on the engine-side default should
+    set it explicitly to keep failover from restarting the budget."""
+    request = dict(args[0])
+    generated = [item["token_id"] for item in items]
+    max_new = request.get("max_new_tokens")
+    eos_id = request.get("eos_id")
+    if eos_id is not None and generated and generated[-1] == eos_id:
+        return None
+    if max_new is not None and len(generated) >= int(max_new):
+        return None
+    request["prompt_ids"] = list(request["prompt_ids"]) + generated
+    if max_new is not None:
+        request["max_new_tokens"] = int(max_new) - len(generated)
+    # The resumed tail is a fresh engine request: a pinned request_id could
+    # collide with the orphaned original still draining on the engine.
+    request.pop("request_id", None)
+    return (request,) + tuple(args[1:]), kwargs
+
+
 class LLMIngress:
     """Deployment callable: JSON dict in, generated token ids (or a token
     stream) out.
@@ -67,10 +100,11 @@ class LLMIngress:
         prompt_ids = request["prompt_ids"]
         max_new_tokens = request.get("max_new_tokens")
         eos_id = request.get("eos_id")
+        request_id = request.get("request_id")
         if request.get("stream"):
             refs = self._engine.generate_stream.options(
                 num_returns="streaming"
-            ).remote(prompt_ids, max_new_tokens, eos_id)
+            ).remote(prompt_ids, max_new_tokens, eos_id, request_id)
 
             def token_stream():
                 for ref in refs:
@@ -78,11 +112,18 @@ class LLMIngress:
 
             return token_stream()
         return ray_tpu.get(
-            self._engine.generate.remote(prompt_ids, max_new_tokens, eos_id)
+            self._engine.generate.remote(
+                prompt_ids, max_new_tokens, eos_id, request_id
+            )
         )
 
     def metrics(self) -> dict:
         return ray_tpu.get(self._engine.metrics.remote())
+
+    def dead_letters(self) -> list:
+        """Records of requests failed in isolation after poisoning an
+        engine step (see LLMServer.dead_letters)."""
+        return ray_tpu.get(self._engine.dead_letters.remote())
 
     def reset_prefix_cache(self) -> None:
         """Drop the engine's cached-but-unreferenced KV blocks (call after
@@ -98,13 +139,23 @@ class LLMIngress:
         from ray_tpu.exceptions import ActorError
 
         try:
-            return bool(
+            healthy = bool(
                 ray_tpu.get(self._engine.check_health.remote(), timeout=1.0)
             )
         except TimeoutError:
             return True
         except ActorError:
             return False
+        if not healthy:
+            # A wedged engine never recovers on its own, and because it is a
+            # NAMED actor, merely replacing this replica would hand the
+            # replacement the same wedged engine (get_if_exists). Put it
+            # down so the replacement replica re-creates it fresh.
+            try:
+                ray_tpu.kill(self._engine)
+            except Exception:
+                pass  # already dead / runtime tearing down
+        return healthy
 
 
 def build_app(

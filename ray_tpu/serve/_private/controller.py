@@ -148,6 +148,14 @@ class ServeControllerActor:
                 return self._version, {}
             return self._version, dict(st.replicas)
 
+    def get_deployment_config(self, app: str, deployment: str):
+        """The deployment's target DeploymentConfig, or None if unknown —
+        lets late-bound handles (serve.get_deployment_handle) honor the
+        configured retry/backoff knobs like serve.run handles do."""
+        with self._lock:
+            st = self._get_state(app, deployment)
+            return None if st is None else st.info["config"]
+
     def listen_for_change(self, known_version: int, timeout_s: float = 10.0):
         """Block until cluster state version advances past known_version
         (reference long-poll: serve/_private/long_poll.py:186)."""
@@ -357,9 +365,12 @@ class ServeControllerActor:
                 **cfg.ray_actor_options,
             },
         )
+        from ray_tpu._private.fault_injection import maybe_fail
+
         started = {}
         for tag in specs:
             try:
+                maybe_fail("controller.start_replica", detail=tag)
                 h = replica_cls.remote(
                     st.name,
                     tag,

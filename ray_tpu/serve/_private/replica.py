@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu._private.fault_injection import maybe_fail
+
 
 class ReplicaActor:
     """One replica of a deployment.
@@ -73,6 +75,10 @@ class ReplicaActor:
     ) -> Any:
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
+        maybe_fail(
+            "replica.handle_request",
+            detail=f"{self._deployment_name}:{self._replica_tag}:{method_name}",
+        )
         with self._lock:
             self._num_ongoing += 1
         token = _set_multiplexed_model_id(multiplexed_model_id)
@@ -106,6 +112,10 @@ class ReplicaActor:
         → StreamingObjectRefGenerator)."""
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
+        maybe_fail(
+            "replica.handle_request_streaming",
+            detail=f"{self._deployment_name}:{self._replica_tag}:{method_name}",
+        )
         with self._lock:
             self._num_ongoing += 1
         token = _set_multiplexed_model_id(multiplexed_model_id)
@@ -135,7 +145,14 @@ class ReplicaActor:
             ):
                 yield result  # non-iterable: a one-item stream
                 return
-            yield from result
+            for item in result:
+                # Chaos hook: die mid-stream after a deterministic number of
+                # items (simulates a replica lost between yields).
+                maybe_fail(
+                    "replica.stream_item",
+                    detail=f"{self._deployment_name}:{self._replica_tag}",
+                )
+                yield item
         finally:
             from ray_tpu.serve.multiplex import _multiplexed_model_id
 

@@ -59,6 +59,8 @@ class Deployment:
         ray_actor_options: Optional[dict] = None,
         health_check_period_s: Optional[float] = None,
         graceful_shutdown_timeout_s: Optional[float] = None,
+        request_retry_budget: Optional[int] = None,
+        request_backoff_initial_s: Optional[float] = None,
     ) -> "Deployment":
         cfg = replace(self._config)
         if num_replicas is not None:
@@ -77,6 +79,10 @@ class Deployment:
             cfg.health_check_period_s = health_check_period_s
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if request_retry_budget is not None:
+            cfg.request_retry_budget = request_retry_budget
+        if request_backoff_initial_s is not None:
+            cfg.request_backoff_initial_s = request_backoff_initial_s
         return Deployment(self._callable_def, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -145,7 +151,11 @@ def run(
         if isinstance(a, Application):
             d = a.deployment
             return DeploymentHandle(
-                name, d.name, d._config.max_concurrent_queries
+                name,
+                d.name,
+                d._config.max_concurrent_queries,
+                retry_budget=d._config.request_retry_budget,
+                backoff_initial_s=d._config.request_backoff_initial_s,
             )
         return a
 
@@ -172,7 +182,11 @@ def run(
     _wait_healthy(controller, name, _blocking_timeout_s)
     ingress = app.deployment
     return DeploymentHandle(
-        name, ingress.name, ingress._config.max_concurrent_queries
+        name,
+        ingress.name,
+        ingress._config.max_concurrent_queries,
+        retry_budget=ingress._config.request_retry_budget,
+        backoff_initial_s=ingress._config.request_backoff_initial_s,
     )
 
 
@@ -197,7 +211,7 @@ def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
 def get_deployment_handle(
     deployment_name: str, app_name: str = _DEFAULT_APP
 ) -> DeploymentHandle:
-    return DeploymentHandle(app_name, deployment_name)
+    return _handle_with_configured_knobs(app_name, deployment_name)
 
 
 def get_app_handle(app_name: str = _DEFAULT_APP) -> DeploymentHandle:
@@ -209,7 +223,36 @@ def get_app_handle(app_name: str = _DEFAULT_APP) -> DeploymentHandle:
     if not app:
         raise ValueError(f"No application named {app_name!r}")
     # The ingress is the first deployment deployed for the app.
-    return DeploymentHandle(app_name, next(iter(app)))
+    return _handle_with_configured_knobs(app_name, next(iter(app)))
+
+
+def _handle_with_configured_knobs(
+    app_name: str, deployment_name: str
+) -> DeploymentHandle:
+    """Build a handle that honors the deployment's configured failover/
+    concurrency knobs (same as the handle serve.run returns); falls back
+    to defaults when the deployment isn't known to the controller yet."""
+    from ray_tpu import api as ray
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    try:
+        cfg = ray.get(
+            get_or_create_controller().get_deployment_config.remote(
+                app_name, deployment_name
+            ),
+            timeout=10.0,
+        )
+    except Exception:
+        cfg = None
+    if cfg is None:
+        return DeploymentHandle(app_name, deployment_name)
+    return DeploymentHandle(
+        app_name,
+        deployment_name,
+        cfg.max_concurrent_queries,
+        retry_budget=cfg.request_retry_budget,
+        backoff_initial_s=cfg.request_backoff_initial_s,
+    )
 
 
 class _NodeProxyActor:

@@ -9,6 +9,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+# Client-side failover defaults, shared by DeploymentConfig and bare
+# Router/DeploymentHandle construction (ray_tpu.serve.handle) so the two
+# paths can't drift.
+DEFAULT_RETRY_BUDGET = 3  # re-dispatches per request after the first attempt
+DEFAULT_BACKOFF_INITIAL_S = 0.05
+
 
 @dataclass
 class AutoscalingConfig:
@@ -53,6 +59,16 @@ class DeploymentConfig:
     ray_actor_options: dict = field(default_factory=dict)
     health_check_period_s: float = 1.0
     graceful_shutdown_timeout_s: float = 5.0
+    # Client-side failover (handle/router): how many times one request may
+    # be re-dispatched to another replica after an ActorDied/Unavailable
+    # failure, and the initial delay of the exponential backoff between
+    # attempts. Budget exhaustion raises the typed
+    # ReplicaUnavailableRetryExhausted. NOTE: a replica can die AFTER
+    # executing a request but before the reply lands, so failover gives
+    # AT-LEAST-ONCE execution — set request_retry_budget=0 for deployments
+    # whose handlers are not idempotent.
+    request_retry_budget: int = DEFAULT_RETRY_BUDGET
+    request_backoff_initial_s: float = DEFAULT_BACKOFF_INITIAL_S
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

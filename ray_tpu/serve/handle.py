@@ -4,40 +4,144 @@ Reference: serve/handle.py:74 (RayServeHandle), serve/_private/router.py:338,
 370 (Router.assign_replica: pick a replica with < max_concurrent_queries in
 flight, block otherwise) and the LongPollClient (_private/long_poll.py:68)
 keeping the replica set fresh without polling per-request.
+
+Fault tolerance: a request that lands on a dead/unavailable replica is
+re-dispatched to another one with exponential backoff, a per-request retry
+budget, and an excluded-replica set (the reference router's
+replica-unavailable retry path). Streaming responses can resume on the new
+replica via a caller-supplied `resume_fn` that folds the items already
+delivered into the re-submitted request — for LLM token streams
+(ray_tpu.llm.serve.llm_stream_resume) the resumed prefill is mostly prefix
+cache hits and the client-visible stream stays contiguous. Budget
+exhaustion raises the typed ReplicaUnavailableRetryExhausted instead of a
+raw ActorDiedError.
 """
 
 from __future__ import annotations
 
-import itertools
 import random
 import threading
 import time
 import uuid
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime import get_runtime
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    ReplicaUnavailableRetryExhausted,
+)
+
+from ray_tpu.serve.config import (
+    DEFAULT_BACKOFF_INITIAL_S,
+    DEFAULT_RETRY_BUDGET,
+)
+
+# Replica failures the router fails over; everything else (user exceptions,
+# timeouts) surfaces to the caller untouched.
+RETRYABLE_ERRORS = (ActorDiedError, ActorUnavailableError)
+
+BACKOFF_MULTIPLIER = 2.0
+BACKOFF_MAX_S = 2.0
+
+
+class _RequestContext:
+    """Per-request failover state shared between the router and the
+    response object: what to re-submit, where it must not go again, and how
+    much retry budget is left."""
+
+    __slots__ = (
+        "method_name",
+        "args",
+        "kwargs",
+        "model_id",
+        "excluded",
+        "failures",
+        "tag",
+    )
+
+    def __init__(self, method_name: str, args: tuple, kwargs: dict, model_id: str):
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.model_id = model_id
+        self.excluded: set[str] = set()
+        self.failures = 0
+        self.tag: Optional[str] = None  # replica serving the latest attempt
 
 
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef (reference:
-    serve/handle.py DeploymentResponse)."""
+    serve/handle.py DeploymentResponse). Retries on replica death by asking
+    the router for a fresh dispatch within the request's retry budget."""
 
-    def __init__(self, ref: ObjectRef):
+    def __init__(self, ref: ObjectRef, router: "Router" = None,
+                 ctx: _RequestContext = None):
         self._ref = ref
+        self._router = router
+        self._ctx = ctx
+
+    @property
+    def replica_tag(self) -> Optional[str]:
+        return self._ctx.tag if self._ctx is not None else None
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         from ray_tpu import api as ray
+        from ray_tpu.exceptions import GetTimeoutError
 
         # In-flight accounting settles via the router's on_sealed callback
-        # when the reply lands — nothing to do here beyond the get.
-        return ray.get(self._ref, timeout=timeout_s)
+        # when the reply lands — nothing to do here beyond the get. The
+        # timeout is ONE deadline across every failover attempt, not a
+        # fresh budget per retry.
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"request to {self._ctx.method_name if self._ctx else '?'}"
+                    f" did not complete within {timeout_s}s (incl. failover)"
+                )
+            try:
+                return ray.get(self._ref, timeout=remaining)
+            except RETRYABLE_ERRORS as exc:
+                if self._router is None or self._ctx is None:
+                    raise
+                delay = self._router.plan_retry(self._ctx, exc)
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise GetTimeoutError(
+                            f"request did not complete within {timeout_s}s "
+                            "(incl. failover)"
+                        ) from exc
+                    delay = min(delay, left)  # never sleep past the deadline
+                time.sleep(delay)
+                self._ref = self._router.dispatch(self._ctx, stream=False)
 
     def __await__(self):
         # Async ingress path: `await handle.remote(...)` resolves without
         # blocking a thread (the underlying ObjectRef registers a seal
         # callback on the running loop).
-        return self._ref.__await__()
+        if self._router is None or self._ctx is None:
+            return self._ref.__await__()
+        return self._await_with_failover().__await__()
+
+    async def _await_with_failover(self):
+        import asyncio
+
+        while True:
+            try:
+                return await self._ref
+            except RETRYABLE_ERRORS as exc:
+                delay = self._router.plan_retry(self._ctx, exc)
+                await asyncio.sleep(delay)
+                loop = asyncio.get_event_loop()
+                self._ref = await loop.run_in_executor(
+                    None, self._router.dispatch, self._ctx, False
+                )
 
     def _to_object_ref(self) -> ObjectRef:
         return self._ref
@@ -49,10 +153,34 @@ _PENDING = object()  # executor-poll slice expired with no item yet
 class DeploymentResponseGenerator:
     """Streaming response: iterates the replica generator's items (sync or
     async), one object per yield (reference: serve handle's
-    DeploymentResponseGenerator over StreamingObjectRefGenerator)."""
+    DeploymentResponseGenerator over StreamingObjectRefGenerator).
 
-    def __init__(self, ref_gen):
+    With a `resume_fn`, a replica dying mid-stream fails over: the items
+    already delivered are folded into a re-submitted request on another
+    replica and the stream continues where it stopped. `resume_fn(args,
+    kwargs, items) -> (args, kwargs) | None` returns the re-submission (or
+    None when the stream was in fact already complete)."""
+
+    def __init__(self, ref_gen, router: "Router" = None,
+                 ctx: _RequestContext = None,
+                 resume_fn: Optional[Callable] = None):
         self._gen = ref_gen
+        self._router = router
+        self._ctx = ctx
+        self._resume_fn = resume_fn
+        # Delivered items are retained only when a resume_fn needs them to
+        # build the re-submission; otherwise just count them.
+        self._items: list = []
+        self._num_delivered = 0
+
+    def _record(self, item) -> None:
+        self._num_delivered += 1
+        if self._resume_fn is not None:
+            self._items.append(item)
+
+    @property
+    def replica_tag(self) -> Optional[str]:
+        return self._ctx.tag if self._ctx is not None else None
 
     def cancel(self) -> None:
         """Stop the replica-side generator at its next yield. Called by the
@@ -67,11 +195,52 @@ class DeploymentResponseGenerator:
         except Exception:
             pass  # runtime tearing down: the stream dies with it
 
+    def _plan_resume(self, exc: BaseException) -> Optional[float]:
+        """Prepare a mid-stream failover. Returns the backoff delay to
+        sleep before re-dispatching, or None when resume_fn reports the
+        stream already complete. Re-raises `exc` when failover can't keep
+        the stream contiguous, and ReplicaUnavailableRetryExhausted when
+        the retry budget is spent."""
+        if self._router is None or self._ctx is None:
+            raise exc
+        if self._num_delivered and self._resume_fn is None:
+            # Items were already delivered and there is no way to re-submit
+            # just the suffix: replaying from scratch would duplicate them.
+            raise exc
+        if self._resume_fn is not None and self._items:
+            resumed = self._resume_fn(
+                self._ctx.args, self._ctx.kwargs, list(self._items)
+            )
+            if resumed is None:
+                # The stream was in fact complete (e.g. the replica died
+                # after the final token): end cleanly WITHOUT burning
+                # retry budget or excluding a replica.
+                return None
+            delay = self._router.plan_retry(self._ctx, exc)
+            self._ctx.args, self._ctx.kwargs = resumed
+            # Items already folded into the re-submission must not be
+            # folded again by a later failover: the next resume is
+            # relative to the updated args.
+            self._items = []
+            return delay
+        return self._router.plan_retry(self._ctx, exc)
+
     def __iter__(self):
         from ray_tpu import api as ray
 
-        for ref in self._gen:
-            yield ray.get(ref)
+        while True:
+            try:
+                for ref in self._gen:
+                    item = ray.get(ref)
+                    self._record(item)
+                    yield item
+                return
+            except RETRYABLE_ERRORS as exc:
+                delay = self._plan_resume(exc)
+                if delay is None:
+                    return
+                time.sleep(delay)
+                self._gen = self._router.dispatch(self._ctx, stream=True)
 
     def __aiter__(self):
         return self._agen()
@@ -81,16 +250,28 @@ class DeploymentResponseGenerator:
 
         loop = asyncio.get_event_loop()
         while True:
-            # Short-sliced executor polls: a stalled stream never parks a
-            # shared executor thread for long (0.2s max), so concurrent
-            # streams timeshare the pool and a cancelled consumer leaks at
-            # most one slice of thread time.
-            ref = await loop.run_in_executor(None, self._poll_next)
-            if ref is None:
-                return
-            if ref is _PENDING:
-                continue
-            yield await ref
+            try:
+                while True:
+                    # Short-sliced executor polls: a stalled stream never
+                    # parks a shared executor thread for long (0.2s max), so
+                    # concurrent streams timeshare the pool and a cancelled
+                    # consumer leaks at most one slice of thread time.
+                    ref = await loop.run_in_executor(None, self._poll_next)
+                    if ref is None:
+                        return
+                    if ref is _PENDING:
+                        continue
+                    item = await ref
+                    self._record(item)
+                    yield item
+            except RETRYABLE_ERRORS as exc:
+                delay = self._plan_resume(exc)
+                if delay is None:
+                    return
+                await asyncio.sleep(delay)
+                self._gen = await loop.run_in_executor(
+                    None, self._router.dispatch, self._ctx, True
+                )
 
     def _poll_next(self):
         from ray_tpu._private.streaming import _SENTINEL
@@ -109,10 +290,25 @@ class Router:
 
     METRICS_PUSH_PERIOD_S = 0.25
 
-    def __init__(self, app: str, deployment: str, max_concurrent_queries: int):
+    def __init__(
+        self,
+        app: str,
+        deployment: str,
+        max_concurrent_queries: int,
+        retry_budget: Optional[int] = None,
+        backoff_initial_s: Optional[float] = None,
+    ):
         self._app = app
         self._deployment = deployment
         self._max_q = max_concurrent_queries
+        self._retry_budget = (
+            DEFAULT_RETRY_BUDGET if retry_budget is None else retry_budget
+        )
+        self._backoff_initial_s = (
+            DEFAULT_BACKOFF_INITIAL_S
+            if backoff_initial_s is None
+            else backoff_initial_s
+        )
         self._handle_id = uuid.uuid4().hex[:12]
         self._lock = threading.Condition()
         self._replicas: dict[str, Any] = {}
@@ -191,32 +387,78 @@ class Router:
         kwargs: dict,
         multiplexed_model_id: str = "",
         stream: bool = False,
+        resume_fn: Optional[Callable] = None,
     ):
+        ctx = _RequestContext(method_name, args, kwargs, multiplexed_model_id)
+        result = self.dispatch(ctx, stream)
+        if stream:
+            return DeploymentResponseGenerator(
+                result, router=self, ctx=ctx, resume_fn=resume_fn
+            )
+        return DeploymentResponse(result, router=self, ctx=ctx)
+
+    def dispatch(self, ctx: _RequestContext, stream: bool):
+        """Pick a replica and submit `ctx`'s request; a submit-time replica
+        failure backs off and retries within the request's budget. Returns
+        the raw ObjectRef (or ref generator for streams)."""
+        while True:
+            try:
+                return self._dispatch_once(ctx, stream)
+            except RETRYABLE_ERRORS as exc:
+                time.sleep(self.plan_retry(ctx, exc))
+
+    def plan_retry(self, ctx: _RequestContext, exc: BaseException) -> float:
+        """Account one failed dispatch attempt: exclude the replica it
+        landed on and compute the exponential backoff delay. Raises the
+        typed ReplicaUnavailableRetryExhausted once the budget is spent."""
+        if ctx.tag is not None:
+            ctx.excluded.add(ctx.tag)
+        ctx.failures += 1
+        if ctx.failures > self._retry_budget:
+            raise ReplicaUnavailableRetryExhausted(
+                deployment=self._deployment,
+                attempts=ctx.failures,
+                last_error=exc,
+            ) from exc
+        return min(
+            self._backoff_initial_s * BACKOFF_MULTIPLIER ** (ctx.failures - 1),
+            BACKOFF_MAX_S,
+        )
+
+    def _dispatch_once(self, ctx: _RequestContext, stream: bool):
         with self._lock:
             self._queued += 1
             prefer = (
-                self._model_affinity.get(multiplexed_model_id)
-                if multiplexed_model_id
+                self._model_affinity.get(ctx.model_id)
+                if ctx.model_id
                 else None
             )
         try:
-            tag, handle = self._pick_replica(prefer=prefer)
+            tag, handle = self._pick_replica(
+                prefer=prefer, excluded=ctx.excluded
+            )
         finally:
             with self._lock:
                 self._queued -= 1
-        if multiplexed_model_id:
+        if ctx.model_id:
             # Cache-affinity: later requests for this model prefer the
             # replica that just (presumably) loaded it. LRU-bounded; recency
             # refreshed on every assignment.
             with self._lock:
-                self._model_affinity[multiplexed_model_id] = tag
-                self._model_affinity.move_to_end(multiplexed_model_id)
+                self._model_affinity[ctx.model_id] = tag
+                self._model_affinity.move_to_end(ctx.model_id)
                 while len(self._model_affinity) > 256:
                     self._model_affinity.popitem(last=False)
+        ctx.tag = tag
         if stream:
-            gen = handle.handle_request_streaming.options(
-                num_returns="streaming"
-            ).remote(method_name, args, kwargs, multiplexed_model_id)
+            try:
+                gen = handle.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(ctx.method_name, ctx.args, ctx.kwargs, ctx.model_id)
+            except BaseException:
+                self._on_done(tag)
+                ctx.excluded.add(tag)
+                raise
 
             # In-flight settles when the generator COMPLETES (the completion
             # ref seals after the last yield).
@@ -226,10 +468,15 @@ class Router:
             get_runtime().store.on_sealed(
                 gen._completion_ref.id, _on_stream_done
             )
-            return DeploymentResponseGenerator(gen)
-        ref = handle.handle_request.remote(
-            method_name, args, kwargs, multiplexed_model_id
-        )
+            return gen
+        try:
+            ref = handle.handle_request.remote(
+                ctx.method_name, ctx.args, ctx.kwargs, ctx.model_id
+            )
+        except BaseException:
+            self._on_done(tag)
+            ctx.excluded.add(tag)
+            raise
 
         # Decrement in-flight when the REPLY arrives, not when the caller
         # reads it — fire-and-forget .remote() must not pin slots forever
@@ -240,17 +487,29 @@ class Router:
             self._on_done(_tag)
 
         get_runtime().store.on_sealed(ref.id, _on_reply)
-        return DeploymentResponse(ref)
+        return ref
 
-    def _pick_replica(self, timeout_s: float = 30.0, prefer: str = None):
+    def _pick_replica(
+        self,
+        timeout_s: float = 30.0,
+        prefer: str = None,
+        excluded: frozenset = frozenset(),
+    ):
         deadline = time.time() + timeout_s
         with self._lock:
             while True:
-                candidates = [
+                available = [
                     (tag, h)
                     for tag, h in self._replicas.items()
                     if self._in_flight.get(tag, 0) < self._max_q
                 ]
+                # Skip replicas this request already failed on — but when
+                # every live replica is excluded, forgive rather than hang:
+                # a later attempt on an excluded-but-alive replica beats
+                # blocking until the pick times out.
+                candidates = [
+                    th for th in available if th[0] not in excluded
+                ] or available
                 if candidates:
                     # Model-affinity: take the preferred replica when it has
                     # capacity (multiplexing cache locality).
@@ -299,6 +558,9 @@ class DeploymentHandle:
         multiplexed_model_id: str = "",
         stream: bool = False,
         _router: Optional[Router] = None,
+        retry_budget: Optional[int] = None,
+        backoff_initial_s: Optional[float] = None,
+        stream_resume_fn: Optional[Callable] = None,
     ):
         self._app = app
         self._deployment = deployment
@@ -307,16 +569,25 @@ class DeploymentHandle:
         self._model_id = multiplexed_model_id
         self._stream = stream
         self._router = _router
+        self._retry_budget = retry_budget
+        self._backoff_initial_s = backoff_initial_s
+        self._stream_resume_fn = stream_resume_fn
 
     def _get_router(self) -> Router:
         if self._router is None:
-            self._router = Router(self._app, self._deployment, self._max_q)
+            self._router = Router(
+                self._app,
+                self._deployment,
+                self._max_q,
+                retry_budget=self._retry_budget,
+                backoff_initial_s=self._backoff_initial_s,
+            )
         return self._router
 
     def remote(self, *args, **kwargs):
         return self._get_router().assign(
             self._method_name, args, kwargs, self._model_id,
-            stream=self._stream,
+            stream=self._stream, resume_fn=self._stream_resume_fn,
         )
 
     def options(
@@ -324,7 +595,13 @@ class DeploymentHandle:
         method_name: Optional[str] = None,
         multiplexed_model_id: Optional[str] = None,
         stream: Optional[bool] = None,
+        retry_budget: Optional[int] = None,
+        backoff_initial_s: Optional[float] = None,
+        stream_resume_fn: Optional[Callable] = None,
     ) -> "DeploymentHandle":
+        changed_router_cfg = (
+            retry_budget is not None or backoff_initial_s is not None
+        )
         h = DeploymentHandle(
             self._app,
             self._deployment,
@@ -334,7 +611,18 @@ class DeploymentHandle:
             if multiplexed_model_id is not None
             else self._model_id,
             stream if stream is not None else self._stream,
-            _router=self._router,
+            # Retry knobs live on the Router, so a shared router can't be
+            # reused when they change.
+            _router=None if changed_router_cfg else self._router,
+            retry_budget=retry_budget
+            if retry_budget is not None
+            else self._retry_budget,
+            backoff_initial_s=backoff_initial_s
+            if backoff_initial_s is not None
+            else self._backoff_initial_s,
+            stream_resume_fn=stream_resume_fn
+            if stream_resume_fn is not None
+            else self._stream_resume_fn,
         )
         return h
 
@@ -346,7 +634,7 @@ class DeploymentHandle:
     def __reduce__(self):
         # Handles are serializable into replicas/tasks; router rebuilds lazily.
         return (
-            DeploymentHandle,
+            _rebuild_handle,
             (
                 self._app,
                 self._deployment,
@@ -354,8 +642,35 @@ class DeploymentHandle:
                 self._method_name,
                 self._model_id,
                 self._stream,
+                self._retry_budget,
+                self._backoff_initial_s,
+                self._stream_resume_fn,
             ),
         )
 
     def __repr__(self):
         return f"DeploymentHandle({self._app}#{self._deployment})"
+
+
+def _rebuild_handle(
+    app,
+    deployment,
+    max_q,
+    method_name,
+    model_id,
+    stream,
+    retry_budget=None,
+    backoff_initial_s=None,
+    stream_resume_fn=None,
+) -> DeploymentHandle:
+    return DeploymentHandle(
+        app,
+        deployment,
+        max_q,
+        method_name,
+        model_id,
+        stream,
+        retry_budget=retry_budget,
+        backoff_initial_s=backoff_initial_s,
+        stream_resume_fn=stream_resume_fn,
+    )

@@ -1,0 +1,494 @@
+"""Chaos tests for the fault-tolerant serving stack.
+
+Deterministic fault injection (ray_tpu._private.fault_injection) drives
+three failure layers:
+
+  * engine — a poisoned request fails alone (dead-letter, KV release) while
+    every other in-flight generation completes token-identically; K
+    consecutive failing steps wedge the engine and broadcast to all waiters;
+  * router — requests landing on dead replicas fail over with exponential
+    backoff, an excluded-replica set, and a typed error on budget
+    exhaustion; streaming LLM requests resume mid-stream on another replica
+    with a contiguous, token-identical greedy stream;
+  * harness — the injection points themselves count hits deterministically.
+
+Every test seeds the model identically (seed=0), so greedy outputs have an
+exact unbatched ground truth to compare against.
+"""
+
+import threading
+import time
+
+import pytest
+
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import fault_injection as fi
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    PoisonRequestError,
+    ReplicaUnavailableRetryExhausted,
+)
+from ray_tpu.llm import EngineConfig, LLMEngine, LLMServer
+from ray_tpu.models.gpt import GPT, GPTConfig
+
+pytestmark = pytest.mark.chaos
+
+TINY = GPTConfig(
+    vocab_size=128,
+    num_layers=2,
+    num_heads=4,
+    embed_dim=64,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+
+ECFG = EngineConfig(
+    block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=8
+)
+
+# Serve-path tests pay the engine actor's init-time warmup (it compiles
+# every bucket); two buckets keep each test well inside the tier-1 budget.
+ECFG_SERVE = EngineConfig(
+    block_size=8,
+    num_blocks=64,
+    max_decode_slots=4,
+    max_blocks_per_seq=8,
+    prefill_buckets=(8, 32),
+)
+
+
+def reference_greedy(model, params, prompt, n_tokens, pad_to=64):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        padded = np.zeros((1, pad_to), np.int32)
+        padded[0, : len(toks)] = toks
+        logits = model.apply(params, jnp.asarray(padded))
+        t = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def random_prompts(lengths, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, vocab, size=n))) for n in lengths]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------- engine layer: poison-request isolation ----------------
+
+
+def _concurrent_generates(server, jobs):
+    """Run several server.generate calls concurrently; returns
+    {request_id: result-or-exception}."""
+    results = {}
+
+    def run(rid, prompt, n):
+        try:
+            results[rid] = server.generate(
+                prompt, max_new_tokens=n, request_id=rid, timeout_s=60.0
+            )
+        except BaseException as exc:  # noqa: BLE001
+            results[rid] = exc
+
+    threads = [
+        threading.Thread(target=run, args=job, daemon=True) for job in jobs
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    return results
+
+
+def test_poisoned_prefill_fails_only_that_request():
+    """Acceptance: a poisoned request (injected step exception during its
+    prefill) is failed in isolation — other in-flight generations finish
+    token-identical to the unbatched reference, the replica stays healthy,
+    and the dead letter shows up in metrics()/dead_letters()."""
+    prompts = random_prompts((5, 11, 3), seed=2)
+    n_new = 8
+    fi.inject(
+        "llm.prefill",
+        match="poison-me",
+        exc_factory=lambda: RuntimeError("cosmic ray in prefill"),
+    )
+    server = LLMServer(TINY, ECFG, seed=0, warmup=False)
+    jobs = [(f"ok-{i}", p, n_new) for i, p in enumerate(prompts)]
+    jobs.append(("poison-me", random_prompts((9,), seed=3)[0], n_new))
+    results = _concurrent_generates(server, jobs)
+
+    # The culprit got the typed error; nobody else did.
+    poisoned = results["poison-me"]
+    assert isinstance(poisoned, PoisonRequestError)
+    assert poisoned.request_id == "poison-me"
+    assert "cosmic ray" in repr(poisoned.cause)
+    model = GPT(TINY)
+    params = server._engine.runner.params
+    for i, p in enumerate(prompts):
+        out = results[f"ok-{i}"]
+        assert not isinstance(out, BaseException), out
+        assert out["token_ids"] == reference_greedy(model, params, p, n_new)
+
+    # Replica stays healthy; the dead letter is visible.
+    assert server.check_health() is True
+    stats = server.metrics()
+    assert stats["num_dead_letters"] == 1
+    assert stats["wedged"] is False
+    letters = server.dead_letters()
+    assert len(letters) == 1
+    assert letters[0]["request_id"] == "poison-me"
+    assert "cosmic ray" in letters[0]["error"]
+    assert letters[0]["prompt_len"] == 9
+    # Its KV blocks were released with it.
+    assert server._engine.allocator.num_allocated == 0
+
+    # The engine keeps serving new work afterwards.
+    out = server.generate(prompts[0], max_new_tokens=4, timeout_s=60.0)
+    assert out["token_ids"] == reference_greedy(model, params, prompts[0], 4)
+    server.shutdown()
+
+
+def test_poisoned_decode_fails_only_that_request():
+    """A fault in one sequence's decode section dead-letters that request
+    mid-generation; the other requests in the same decode batch continue
+    unperturbed (their state only mutates after the risky calls)."""
+    prompts = random_prompts((7, 6), seed=4)
+    fi.inject(
+        "llm.decode.seq",
+        match="poison-me",
+        nth=3,  # fail on its 3rd decode iteration, mid-stream
+        exc_factory=lambda: RuntimeError("decode bitflip"),
+    )
+    server = LLMServer(TINY, ECFG, seed=0, warmup=False)
+    jobs = [
+        ("ok-0", prompts[0], 10),
+        ("poison-me", prompts[1], 10),
+    ]
+    results = _concurrent_generates(server, jobs)
+    assert isinstance(results["poison-me"], PoisonRequestError)
+    model = GPT(TINY)
+    params = server._engine.runner.params
+    assert results["ok-0"]["token_ids"] == reference_greedy(
+        model, params, prompts[0], 10
+    )
+    assert server.check_health() is True
+    letters = server.dead_letters()
+    assert [d["request_id"] for d in letters] == ["poison-me"]
+    assert letters[0]["tokens_generated"] >= 1  # died mid-generation
+    server.shutdown()
+
+
+def test_poison_in_multi_prefill_step_requeues_innocent_admits():
+    """With max_prefills_per_step > 1, a poisoned prefill must not leave
+    the OTHER sequences admitted in the same step decoding from K/V that
+    was never computed: they are requeued recompute-style and finish
+    token-identical after the culprit is failed."""
+    ecfg = EngineConfig(
+        block_size=8,
+        num_blocks=64,
+        max_decode_slots=4,
+        max_blocks_per_seq=8,
+        max_prefills_per_step=4,
+    )
+    fi.inject(
+        "llm.prefill",
+        match="poison-me",
+        exc_factory=lambda: RuntimeError("poisoned first admit"),
+    )
+    eng = LLMEngine(TINY, ecfg, seed=0)
+    prompts = random_prompts((6, 9), seed=10)
+    tokens = []
+    eng.add_request(prompts[0], max_new_tokens=6, request_id="poison-me")
+    eng.add_request(
+        prompts[1], max_new_tokens=6, request_id="ok", on_token=tokens.append
+    )
+    with pytest.raises(RuntimeError, match="poisoned first admit"):
+        eng.step()  # both admitted; the first one's prefill raises
+    assert eng.culprit_for(RuntimeError()) == "poison-me"  # via _current_rid
+    assert eng.fail_request("poison-me", RuntimeError("poisoned first admit"))
+    while eng.has_work():
+        eng.step()
+    want = reference_greedy(GPT(TINY), eng.runner.params, prompts[1], 6)
+    assert tokens == want
+    assert eng.allocator.num_allocated == 0
+    assert [d["request_id"] for d in eng.dead_letters()] == ["poison-me"]
+
+
+def test_engine_wedges_after_k_consecutive_failing_steps():
+    """Satellite + tentpole: unattributable step failures retry, but K
+    consecutive failures wedge the engine — the error reaches EVERY
+    concurrent generate/generate_stream waiter, check_health() flips false,
+    and _submit raises afterwards."""
+    ecfg = EngineConfig(
+        block_size=8,
+        num_blocks=64,
+        max_decode_slots=4,
+        max_blocks_per_seq=8,
+        max_consecutive_step_failures=2,
+    )
+    # Steps 1-2 succeed (tokens flow), then every step fails
+    # unattributably: step 3 retries, step 4 wedges (K=2).
+    fi.inject("llm.step", nth=3, times=None, message="engine meltdown")
+    server = LLMServer(TINY, ecfg, seed=0, warmup=False)
+    prompts = random_prompts((5, 7), seed=5)
+
+    stream_tokens = []
+    stream_error = []
+
+    def run_stream():
+        try:
+            for tok in server.generate_stream(
+                prompts[1], max_new_tokens=16, timeout_s=60.0
+            ):
+                stream_tokens.append(tok)
+        except BaseException as exc:  # noqa: BLE001
+            stream_error.append(exc)
+
+    stream_thread = threading.Thread(target=run_stream, daemon=True)
+    stream_thread.start()
+    results = _concurrent_generates(server, [("g0", prompts[0], 16)])
+    stream_thread.join(timeout=90)
+
+    # Both waiters saw the broadcast error (not a timeout, not a hang).
+    assert isinstance(results["g0"], fi.InjectedFault)
+    assert stream_error and isinstance(stream_error[0], fi.InjectedFault)
+    assert server.check_health() is False
+    assert server.metrics()["wedged"] is True
+    # New submissions fail fast after the crash.
+    with pytest.raises(RuntimeError, match="not running"):
+        server.generate([1, 2], max_new_tokens=1)
+
+
+def test_unattributable_failure_below_threshold_recovers():
+    """A transient unattributable step failure (fails twice, then stops) is
+    retried in place: no dead letters, no wedge, token-identical output."""
+    fi.inject("llm.step", nth=2, times=2, message="transient glitch")
+    server = LLMServer(TINY, ECFG, seed=0, warmup=False)
+    prompt = random_prompts((6,), seed=6)[0]
+    out = server.generate(prompt, max_new_tokens=8, timeout_s=60.0)
+    model = GPT(TINY)
+    want = reference_greedy(model, server._engine.runner.params, prompt, 8)
+    assert out["token_ids"] == want
+    assert server.check_health() is True
+    assert server.metrics()["num_dead_letters"] == 0
+    server.shutdown()
+
+
+# ---------------- router layer: failover + resume ----------------
+
+
+@pytest.fixture
+def serve_ray():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_unary_failover_retries_on_another_replica(serve_ray):
+    """A replica failing with ActorDiedError on the first dispatch is
+    excluded and the request re-dispatched; the caller sees the result,
+    not the error."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="failover-unary")
+    spec = fi.inject(
+        "replica.handle_request",
+        match="double",
+        exc_factory=lambda: ActorDiedError(None, "injected replica death"),
+    )
+    assert handle.remote(21).result(timeout_s=30) == 42
+    assert spec.fires == 1  # the failure really happened, and was survived
+
+
+def test_retry_budget_exhaustion_raises_typed_error_with_backoff(serve_ray):
+    """Acceptance: when every dispatch fails, the router backs off
+    exponentially between attempts and, after the configured budget,
+    surfaces ReplicaUnavailableRetryExhausted — not a raw ActorDiedError."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="failover-budget")
+    assert handle.remote(1).result(timeout_s=30) == 1  # sanity: app works
+
+    backoff = 0.05
+    spec = fi.inject(
+        "actor.submit",
+        match="ReplicaActor.handle_request",
+        times=None,
+        exc_factory=lambda: ActorDiedError(None, "injected submit failure"),
+    )
+    tuned = handle.options(retry_budget=2, backoff_initial_s=backoff)
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaUnavailableRetryExhausted) as ei:
+        tuned.remote(2)
+    elapsed = time.monotonic() - t0
+    assert ei.value.attempts == 3  # initial + 2 retries
+    assert isinstance(ei.value.last_error, ActorDiedError)
+    assert spec.fires == 3
+    # Exponential backoff between attempts: 0.05s then 0.10s.
+    assert elapsed >= backoff + 2 * backoff
+    fi.clear()
+    # The deployment still serves once the faults stop.
+    assert tuned.remote(3).result(timeout_s=30) == 3
+
+
+def _build_llm_app(serve_run, engine_name, app_name, num_replicas=2):
+    from ray_tpu.llm.serve import build_app
+
+    return serve_run(
+        build_app(
+            TINY, ECFG_SERVE, engine_name=engine_name,
+            num_replicas=num_replicas
+        ),
+        name=app_name,
+    )
+
+
+def test_llm_stream_failover_injected_token_identical(serve_ray):
+    """Acceptance: a replica dying mid-stream (injected ActorDiedError
+    between yields) fails over, resuming on another replica by re-submitting
+    prompt + tokens-generated-so-far — the client-visible greedy stream is
+    uninterrupted and token-identical to a failure-free run."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+
+    handle = _build_llm_app(serve.run, "chaos-inj", "llmchaos1")
+    prompt = random_prompts((7,), seed=7)[0]
+    n_new = 8
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params, prompt, n_new
+    )
+
+    spec = fi.inject(
+        "replica.stream_item",
+        nth=4,  # die after delivering 3 tokens
+        exc_factory=lambda: ActorDiedError(None, "injected mid-stream kill"),
+    )
+    stream = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    tokens = [d["token_id"] for d in stream]
+    assert spec.fires == 1  # the mid-stream death really happened
+    assert tokens == want
+
+
+def test_llm_stream_double_failover_token_identical(serve_ray):
+    """Two replica deaths during ONE stream: each resume must fold only the
+    tokens delivered since the previous resume (regression: re-folding the
+    first batch duplicated prompt context and truncated the budget)."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+
+    handle = _build_llm_app(serve.run, "chaos-inj2", "llmchaos4")
+    prompt = random_prompts((6,), seed=11)[0]
+    n_new = 8
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params,
+        prompt, n_new,
+    )
+    # Fires on the 3rd and 6th delivered items: 2 tokens, die, resume,
+    # 2 more tokens, die again, resume again, finish.
+    spec = fi.inject(
+        "replica.stream_item",
+        every=3,
+        times=2,
+        exc_factory=lambda: ActorDiedError(None, "injected double kill"),
+    )
+    stream = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    tokens = [d["token_id"] for d in stream]
+    assert spec.fires == 2
+    assert tokens == want
+
+
+def test_llm_stream_failover_real_replica_kill_token_identical(serve_ray):
+    """Same acceptance via a real ray_tpu.kill of the replica serving the
+    stream (≥2 replicas deployed): the router excludes the dead replica,
+    resumes on the survivor, and the greedy stream stays token-identical.
+    The resumed prefill mostly hits the prefix cache (PR 2), so failover
+    costs roughly one tail prefill."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    handle = _build_llm_app(serve.run, "chaos-kill", "llmchaos2")
+    prompt = random_prompts((9,), seed=8)[0]
+    n_new = 10
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params, prompt, n_new
+    )
+
+    gen = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    it = iter(gen)
+    received = [next(it)["token_id"] for _ in range(3)]
+    serving_tag = gen.replica_tag
+    assert serving_tag is not None
+    _, replicas = ray_tpu.get(
+        get_or_create_controller().get_replica_snapshot.remote(
+            "llmchaos2", "LLMIngress"
+        )
+    )
+    ray_tpu.kill(replicas[serving_tag])
+    received += [d["token_id"] for d in it]
+    assert received == want
+    # Failover really moved the stream to a different replica.
+    assert gen.replica_tag != serving_tag
+
+
+def test_poisoned_request_isolated_through_serve_path(serve_ray):
+    """End-to-end: a poisoned request through the Serve ingress fails with
+    a typed error while a concurrent request completes token-identically,
+    and the dead letter is visible through the ingress metrics API."""
+    from ray_tpu import serve
+
+    handle = _build_llm_app(serve.run, "chaos-poison", "llmchaos3", 1)
+    prompts = random_prompts((5, 6), seed=9)
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params, prompts[0], 6
+    )
+    fi.inject(
+        "llm.prefill",
+        match="poison-via-serve",
+        exc_factory=lambda: RuntimeError("poisoned via serve"),
+    )
+    ok = handle.remote({"prompt_ids": prompts[0], "max_new_tokens": 6})
+    bad = handle.remote(
+        {
+            "prompt_ids": prompts[1],
+            "max_new_tokens": 6,
+            "request_id": "poison-via-serve",
+        }
+    )
+    with pytest.raises(PoisonRequestError):
+        bad.result(timeout_s=60)
+    assert ok.result(timeout_s=60)["token_ids"] == want
+    letters = handle.dead_letters.remote().result(timeout_s=30)
+    assert [d["request_id"] for d in letters] == ["poison-via-serve"]
+    stats = handle.metrics.remote().result(timeout_s=30)
+    assert stats["num_dead_letters"] == 1
+    assert stats["wedged"] is False

@@ -200,6 +200,89 @@ def test_infeasible_task_fails(ray_start_regular):
         ray_tpu.get(impossible.remote(), timeout=10)
 
 
+def test_task_error_as_instanceof_cause():
+    """TaskError.as_instanceof_cause() must return an exception that IS an
+    instance of the cause's class (so `except ValueError:` catches a remote
+    ValueError), not the bare TaskError."""
+    err = TaskError(ValueError("kapow"), "traceback here", "mytask")
+    derived = err.as_instanceof_cause()
+    assert isinstance(derived, ValueError)
+    assert isinstance(derived, TaskError)
+    assert derived.cause is err.cause
+    assert derived.task_name == "mytask"
+    # A nested TaskError cause unwraps to the inner error.
+    inner = TaskError(RuntimeError("deep"), "", "inner")
+    assert TaskError(inner, "", "outer").as_instanceof_cause() is inner
+
+
+def test_actor_method_options_name_is_plumbed(ray_start_regular):
+    """Regression: ActorMethod.options(name=...) used to silently drop the
+    name; it must survive chained options and become the task's display
+    name."""
+
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    a = A.remote()
+    m = a.f.options(name="custom-display-name")
+    assert m._name == "custom-display-name"
+    # Chaining another options() call must not drop it either.
+    assert m.options(num_returns=1)._name == "custom-display-name"
+    assert ray_tpu.get(m.remote()) == 1
+
+
+def test_cancel_recursive_cancels_children(ray_start_regular, tmp_path):
+    """ray_tpu.cancel(recursive=True) cancels tasks submitted BY the
+    cancelled task; recursive=False leaves them to run."""
+    import os
+
+    def setup(stop_name, marker_name):
+        stop = tmp_path / stop_name
+        marker = tmp_path / marker_name
+
+        @ray_tpu.remote
+        def child(path):
+            open(path, "w").write("ran")
+            return 1
+
+        @ray_tpu.remote(num_cpus=0)
+        def parent(path):
+            child.remote(path)  # queued: every CPU is held by a blocker
+            time.sleep(1.0)  # stay alive so the cancel targets a live tree
+            return "parent"
+
+        @ray_tpu.remote
+        def blocker(stop_path):
+            while not os.path.exists(stop_path):
+                time.sleep(0.05)
+
+        blockers = [blocker.remote(str(stop)) for _ in range(4)]
+        time.sleep(0.3)  # blockers occupy all 4 CPUs
+        pref = parent.remote(str(marker))
+        time.sleep(0.3)  # parent submitted its child; child is queued
+        return pref, stop, marker, blockers
+
+    # recursive=True: the queued child is cancelled and never runs.
+    pref, stop, marker, blockers = setup("stop1", "marker1")
+    ray_tpu.cancel(pref, recursive=True)
+    open(stop, "w").write("1")
+    time.sleep(0.6)
+    assert not marker.exists()
+    del blockers
+
+    # recursive=False: the child survives the parent's cancel and runs.
+    pref2, stop2, marker2, blockers2 = setup("stop2", "marker2")
+    ray_tpu.cancel(pref2, recursive=False)
+    open(stop2, "w").write("1")
+    deadline = time.time() + 5
+    while time.time() < deadline and not marker2.exists():
+        time.sleep(0.05)
+    assert marker2.exists()
+    del blockers2
+
+
 def test_cancel_queued_task(ray_start_regular):
     @ray_tpu.remote
     def blocker():

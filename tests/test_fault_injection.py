@@ -1,0 +1,127 @@
+"""Unit tests for the deterministic fault-injection harness
+(ray_tpu._private.fault_injection): hit counting, nth/every/probability
+triggers, match filtering, delay action, env parsing, and cleanup."""
+
+import time
+
+import pytest
+
+from ray_tpu._private import fault_injection as fi
+from ray_tpu.exceptions import ActorDiedError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def test_noop_without_specs():
+    # No specs registered: maybe_fail must be free of side effects.
+    fi.maybe_fail("llm.step")
+    fi.maybe_fail("anything", detail="whatever")
+
+
+def test_nth_hit_then_times_budget():
+    spec = fi.inject("site.a", nth=3, times=2)
+    fi.maybe_fail("site.a")
+    fi.maybe_fail("site.a")
+    assert spec.fires == 0
+    with pytest.raises(fi.InjectedFault):
+        fi.maybe_fail("site.a")  # 3rd hit fires
+    with pytest.raises(fi.InjectedFault):
+        fi.maybe_fail("site.a")  # still >= nth, budget allows one more
+    fi.maybe_fail("site.a")  # times=2 exhausted: no-op again
+    assert spec.hits == 5 and spec.fires == 2
+
+
+def test_match_filters_by_detail_substring():
+    spec = fi.inject("site.b", match="victim")
+    fi.maybe_fail("site.b", detail="innocent-request")
+    assert spec.hits == 0  # non-matching hits are not even counted
+    with pytest.raises(fi.InjectedFault):
+        fi.maybe_fail("site.b", detail="the-victim-request")
+    fi.maybe_fail("site.c", detail="the-victim-request")  # wrong site
+    assert spec.fires == 1
+
+
+def test_every_kth_hit():
+    spec = fi.inject("site.d", every=2, times=None)
+    outcomes = []
+    for _ in range(6):
+        try:
+            fi.maybe_fail("site.d")
+            outcomes.append("ok")
+        except fi.InjectedFault:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "boom", "ok", "boom", "ok", "boom"]
+    assert spec.fires == 3
+
+
+def test_probability_is_seed_deterministic():
+    def run(seed):
+        fi.clear()
+        fi.inject("site.e", probability=0.5, seed=seed, times=None)
+        out = []
+        for _ in range(32):
+            try:
+                fi.maybe_fail("site.e")
+                out.append(0)
+            except fi.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = run(7), run(7)
+    assert a == b  # same seed -> identical failure sequence
+    assert run(8) != a  # different seed -> different sequence
+    assert 0 < sum(a) < 32
+
+
+def test_delay_action_sleeps_instead_of_raising():
+    fi.inject("site.f", action="delay", delay_s=0.15, times=1)
+    t0 = time.monotonic()
+    fi.maybe_fail("site.f")  # delays
+    fi.maybe_fail("site.f")  # budget spent: no delay
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_custom_exception_factory():
+    fi.inject(
+        "site.g", exc_factory=lambda: ActorDiedError(None, "injected death")
+    )
+    with pytest.raises(ActorDiedError, match="injected death"):
+        fi.maybe_fail("site.g")
+
+
+def test_injected_context_manager_removes_spec():
+    with fi.injected("site.h", nth=1) as spec:
+        with pytest.raises(fi.InjectedFault):
+            fi.maybe_fail("site.h")
+        assert spec.fires == 1
+    fi.maybe_fail("site.h")  # spec removed on exit
+    assert fi.specs() == []
+
+
+def test_env_parsing():
+    specs = fi.configure_from_env(
+        "site=llm.step,nth=2,times=3;"
+        "site=actor.submit,match=handle_request,exc=ActorDiedError,delay_s=0.5"
+    )
+    assert len(specs) == 2
+    assert specs[0].site == "llm.step"
+    assert specs[0].nth == 2 and specs[0].times == 3
+    assert specs[1].match == "handle_request"
+    assert isinstance(specs[1].exc_factory(), ActorDiedError)
+    assert specs[1].delay_s == 0.5
+    with pytest.raises(ValueError, match="site"):
+        fi.configure_from_env("nth=2")
+    with pytest.raises(ValueError, match="unknown exception"):
+        fi.configure_from_env("site=x,exc=NoSuchError")
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="action"):
+        fi.inject("x", action="explode")
+    with pytest.raises(ValueError, match="nth"):
+        fi.inject("x", nth=0)
