@@ -20,7 +20,10 @@ Endpoints:
   GET /api/logs[?node_id=&wid=&after_seq=&limit=]   log buffer tail
   GET /api/timeline          chrome://tracing JSON of task events
   GET /api/metrics_history[?limit=&since=]   gauge-suite timeseries ring
-  GET /metrics               prometheus text exposition
+  GET /api/llm[?steps=]      LLM engine panel: stats, flight recorder,
+                             dead letters, per named engine actor
+  GET /metrics               prometheus text exposition (runtime gauges AND
+                             LLM engine gauges refreshed at scrape time)
 """
 
 from __future__ import annotations
@@ -50,6 +53,7 @@ _PAGE = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Task summary</h2><table id="tasks"></table>
+<h2>LLM engines</h2><div id="llm">none</div>
 <h2>History <span id="hist_legend" style="font-size:.75rem;font-weight:normal"></span></h2>
 <canvas id="hist" width="900" height="160"
   style="background:#fff;border:1px solid #ddd;width:100%;max-width:900px"></canvas>
@@ -77,6 +81,35 @@ function drawHistory(samples){
   }
 }
 async function j(u){const r=await fetch(u);return r.json()}
+function renderLLM(engines){
+  const el=document.getElementById('llm');
+  if(!engines.length){el.textContent='none';return}
+  el.innerHTML=engines.map(e=>{
+    if(e.error)return `<p><b>${esc(e.name)}</b> <span class=bad>${esc(e.error)}</span></p>`;
+    const m=e.metrics,fr=e.flight_record;
+    const head=`<p><b class=mono>${esc(e.name)}</b> · `+
+      `${m.wedged?'<span class=bad>WEDGED</span>':'<span class=ok>healthy</span>'} · `+
+      `steps ${m.steps} · decode tok ${m.decode_tokens} · `+
+      `occupancy ${(m.mean_occupancy??0).toFixed(2)} · `+
+      `cache ${(m.cache_utilization??0).toFixed(2)} · `+
+      `hit rate ${(m.prefix_cache_hit_rate??0).toFixed(2)} · `+
+      `queue ${m.queue_depth} · preempt ${m.num_preemptions} · `+
+      `dead letters ${m.num_dead_letters}</p>`;
+    const steps=(fr.steps||[]).slice(-12).map(s=>
+      `<tr><td>${s.step}</td><td>${esc(s.phase)}</td><td>${s.batch_size}</td>`+
+      `<td>${s.tokens_in}/${s.tokens_out}</td><td>${s.cache_hit_tokens}</td>`+
+      `<td>${s.preempted}</td><td>${(1e3*s.duration_s).toFixed(1)}ms</td></tr>`).join('');
+    const stepTable=steps?`<table><tr><th>step</th><th>phase</th><th>batch</th>`+
+      `<th>tok in/out</th><th>cache hits</th><th>preempt</th><th>dur</th></tr>${steps}</table>`:'';
+    const compiles=(fr.compile_events||[]).map(c=>
+      `${esc(c.program)}[${c.bucket}] ${c.compile_s.toFixed(2)}s`).join(' · ');
+    const fails=(fr.failures||[]).slice(-5).map(f=>
+      `<li class=bad>step ${f.step} ${esc(f.action)}: ${esc(f.error)}</li>`).join('');
+    return head+stepTable+
+      (compiles?`<p style="font-size:.8rem">warmup compiles: ${compiles}</p>`:'')+
+      (fails?`<ul style="font-size:.8rem">${fails}</ul>`:'');
+  }).join('<hr>');
+}
 function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
   .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
 function fill(id, rows, cols){
@@ -100,6 +133,7 @@ async function refresh(){
          ['actor_id','class_name','state','name','num_restarts']);
     const s=await j('/api/task_summary');
     fill('tasks', Object.entries(s).map(([k,v])=>({task:k,count:v})));
+    renderLLM(await j('/api/llm?steps=12'));
     const logs=await j('/api/logs?limit=200');
     document.getElementById('logs').textContent=
       logs.map(l=>`(pid=${l.pid}, node=${l.hostname}) ${l.line}`).join('\\n');
@@ -110,6 +144,44 @@ async function refresh(){
 }
 refresh();
 </script></body></html>"""
+
+
+def _llm_engines_snapshot(runtime, steps_limit: int = 32) -> list:
+    """One row per live named LLM engine actor: metrics(), the tail of the
+    flight recorder, and the dead-letter ring. Engine failures degrade to
+    an error field on the row, never a 500 on the panel."""
+    from ray_tpu.util.runtime_metrics import list_llm_engine_actors
+
+    import ray_tpu
+
+    # One combined RPC per engine, all fired up front and collected
+    # against one shared deadline: a busy engine's lock is awaited once,
+    # and N engines cost the panel max-of-N, not sum-of-N.
+    pending = []
+    for name, namespace in list_llm_engine_actors(runtime):
+        row = {"name": name}
+        try:
+            handle = ray_tpu.get_actor(name, namespace=namespace)
+            pending.append(
+                (row, handle.observability_snapshot.remote(steps_limit))
+            )
+        except Exception as exc:
+            row["error"] = repr(exc)
+            pending.append((row, None))
+    deadline = time.time() + 2.0
+    rows = []
+    for row, ref in pending:
+        if ref is not None:
+            try:
+                row.update(
+                    ray_tpu.get(
+                        ref, timeout=max(deadline - time.time(), 0.05)
+                    )
+                )
+            except Exception as exc:
+                row["error"] = repr(exc)
+        rows.append(row)
+    return rows
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -208,10 +280,20 @@ class _Handler(BaseHTTPRequestHandler):
                 if history is not None
                 else []
             )
+        elif path == "/api/llm":
+            self._json(
+                _llm_engines_snapshot(
+                    runtime, steps_limit=int(q.get("steps", 32))
+                )
+            )
         elif path == "/metrics":
-            from ray_tpu.util.runtime_metrics import sample_runtime_metrics
+            from ray_tpu.util.runtime_metrics import (
+                sample_llm_engine_metrics,
+                sample_runtime_metrics,
+            )
 
             sample_runtime_metrics(runtime)  # scrape-time freshness
+            sample_llm_engine_metrics(runtime)  # idle engines stay current
             self._send(200, metrics.prometheus_text().encode(), "text/plain")
         else:
             self._send(404, b"not found", "text/plain")

@@ -8,6 +8,8 @@ Pure-Python library on the actor/object core (the Ray layering principle):
   * scheduler.py — iteration-level prefix-aware admission, continuation,
     preemption
   * engine.py — LLMEngine core + LLMServer engine actor
+  * observability.py — per-request lifecycle spans, latency-histogram
+    boundaries, and the engine flight recorder
   * serve.py — ingress deployment behind the existing HTTP proxy/replicas
 """
 

@@ -52,6 +52,14 @@ class EngineConfig:
     max_consecutive_step_failures: int = 3
     # How many dead-letter records (id, prompt hash, error) to retain.
     dead_letter_capacity: int = 64
+    # Per-request observability: lifecycle phase spans (queue/prefill/
+    # decode/preempt via util.tracing), the TTFT / time-per-output-token /
+    # queue / e2e / step-seconds histograms, and the per-step flight-
+    # recorder ring. False compiles it all out of the step loop (coarse
+    # engine gauges/counters and failure records remain).
+    instrument: bool = True
+    # How many per-step flight-recorder records to retain.
+    flight_recorder_capacity: int = 256
 
     @property
     def max_model_len(self) -> int:
@@ -82,6 +90,8 @@ class EngineConfig:
             raise ValueError("max_consecutive_step_failures must be >= 1")
         if self.dead_letter_capacity < 1:
             raise ValueError("dead_letter_capacity must be >= 1")
+        if self.flight_recorder_capacity < 1:
+            raise ValueError("flight_recorder_capacity must be >= 1")
         from ray_tpu.llm.cache import EVICTION_POLICIES
 
         if self.prefix_eviction_policy not in EVICTION_POLICIES:

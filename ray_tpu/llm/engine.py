@@ -6,9 +6,13 @@ sequences. `LLMServer` wraps it for actor use: a background step loop, a
 blocking `generate`, and a `generate_stream` generator that pairs with
 `.options(num_returns="streaming")` on the actor handle.
 
-Observability (ray_tpu.util.metrics): tokens/sec counters, decode batch
-occupancy, cache utilization, and queue depth, all exported through the
-standard Prometheus registry.
+Observability (ray_tpu.util.metrics + util.tracing + llm.observability):
+tokens/sec counters, decode batch occupancy, cache utilization, and queue
+depth, plus — when EngineConfig.instrument is on — per-request lifecycle
+spans (queue/prefill/decode/preempt, connected to the submitting task's
+trace), TTFT / time-per-output-token / queue / e2e latency histograms, and
+a flight-recorder ring of per-step records, all exported through the
+standard Prometheus registry / tracing.traces() / flight_record().
 """
 
 from __future__ import annotations
@@ -28,6 +32,13 @@ from ray_tpu.exceptions import PoisonRequestError
 from ray_tpu.llm.cache import BlockAllocator, blocks_for_tokens
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.llm.model_runner import GPTRunner
+from ray_tpu.llm.observability import (
+    PER_TOKEN_SECONDS_BOUNDARIES,
+    REQUEST_SECONDS_BOUNDARIES,
+    STEP_SECONDS_BOUNDARIES,
+    FlightRecorder,
+    RequestTrace,
+)
 from ray_tpu.llm.scheduler import (
     FINISH_EOS,
     FINISH_ERROR,
@@ -37,7 +48,8 @@ from ray_tpu.llm.scheduler import (
     Sequence,
 )
 from ray_tpu.models.gpt import GPTConfig
-from ray_tpu.util.metrics import Counter, Gauge, get_or_create
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, get_or_create
 
 
 class LLMEngine:
@@ -127,6 +139,62 @@ class LLMEngine:
             "Requests failed in isolation after poisoning an engine step",
             tag_keys=("engine",),
         )
+        # Request-level latency histograms (the serving SLO trio + queue):
+        # observed only at lifecycle boundaries, never per token.
+        self._h_ttft = get_or_create(
+            Histogram,
+            "llm_request_ttft_seconds",
+            "Submission to first generated token",
+            boundaries=REQUEST_SECONDS_BOUNDARIES,
+            tag_keys=("engine",),
+        )
+        self._h_tpot = get_or_create(
+            Histogram,
+            "llm_request_time_per_output_token_seconds",
+            "Mean inter-token latency after the first token, per request",
+            boundaries=PER_TOKEN_SECONDS_BOUNDARIES,
+            tag_keys=("engine",),
+        )
+        self._h_queue = get_or_create(
+            Histogram,
+            "llm_request_queue_time_seconds",
+            "Waiting-for-a-decode-slot time (one sample per admission, "
+            "including preempt-resume re-admissions)",
+            boundaries=REQUEST_SECONDS_BOUNDARIES,
+            tag_keys=("engine",),
+        )
+        self._h_e2e = get_or_create(
+            Histogram,
+            "llm_request_e2e_seconds",
+            "Submission to terminal state",
+            boundaries=REQUEST_SECONDS_BOUNDARIES,
+            tag_keys=("engine",),
+        )
+        self._h_step = get_or_create(
+            Histogram,
+            "llm_engine_step_seconds",
+            "One engine phase dispatch (prefill per sequence, decode per "
+            "batched step)",
+            boundaries=STEP_SECONDS_BOUNDARIES,
+            tag_keys=("engine", "phase"),
+        )
+        # Pre-merged tag dicts so the step loop never builds dicts.
+        self._step_tags = {
+            "prefill": {**self._metric_tags, "phase": "prefill"},
+            "partial_prefill": {**self._metric_tags, "phase": "partial_prefill"},
+            "decode": {**self._metric_tags, "phase": "decode"},
+        }
+        # Observability plane (EngineConfig.instrument): per-request phase
+        # spans + the per-step flight-recorder ring. The recorder object
+        # always exists (step FAILURES are recorded regardless), but
+        # per-step records and spans are compiled out when instrument=False.
+        self._instrument = self.engine_config.instrument
+        self.flight_recorder = FlightRecorder(
+            self.engine_config.flight_recorder_capacity
+        )
+        self._req_traces: Dict[str, RequestTrace] = {}
+        if self._instrument:
+            self.scheduler.on_preempt = self._note_preempt
         # Poison-request isolation: records of requests failed in isolation
         # after an attributable step exception, newest last.
         self._dead_letters: deque = deque(
@@ -199,6 +267,15 @@ class LLMEngine:
         if on_finish is not None:
             self._on_finish[request_id] = on_finish
         self.scheduler.add(Sequence(req))
+        if self._instrument:
+            # Submission runs on the caller's thread (an actor-task context
+            # when reached through LLMServer), so the ambient trace context
+            # chains this request's lifecycle spans under the Serve
+            # handle → replica → engine-actor task spans. The engine loop
+            # thread later emits against the captured context explicitly.
+            self._req_traces[request_id] = RequestTrace(
+                request_id, tracing.capture_context()
+            )
         return request_id
 
     def abort(self, request_id: str) -> bool:
@@ -246,6 +323,11 @@ class LLMEngine:
             }
         )
         self._dead_letter_count.inc(tags=self._metric_tags)
+        rt = self._req_traces.get(request_id)
+        if rt is not None:
+            # The request span closes with error status + the step
+            # exception that killed it (dead-letter attribution).
+            rt.error = repr(exc)
         self._finished(seq)
         return True
 
@@ -253,6 +335,21 @@ class LLMEngine:
         """Records of requests failed in isolation, oldest first (bounded
         by EngineConfig.dead_letter_capacity)."""
         return list(self._dead_letters)
+
+    def close_traces(self, exc: BaseException) -> None:
+        """Close every in-flight request's trace with error status. The
+        wedge and shutdown broadcasts end requests WITHOUT _finished()
+        running, which would otherwise strand their emitted phase spans
+        under a root span that never gets written — exactly during the
+        incident the trace exists to explain."""
+        now = time.time()
+        error = repr(exc)
+        for rid, rt in list(self._req_traces.items()):
+            rt.error = error
+            seq = self.scheduler._active.get(rid)
+            if seq is not None:
+                rt.on_finish(now, seq)
+        self._req_traces.clear()
 
     # ---------------- stepping ----------------
 
@@ -264,10 +361,13 @@ class LLMEngine:
         step_hit_tokens = 0
         self._current_rid = None
         maybe_fail("llm.step")
+        instrument = self._instrument
+        t_step = time.time() if instrument else 0.0
 
         admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
+        prefill_info: List[dict] = []
         try:
-            step_hit_tokens += self._run_prefills(admitted)
+            step_hit_tokens += self._run_prefills(admitted, prefill_info)
         except BaseException:
             # A failed prefill must not leave admitted-but-never-prefilled
             # sequences in the running set (they would decode from K/V that
@@ -282,6 +382,7 @@ class LLMEngine:
             raise
 
         decoding = self.scheduler.schedule_decode()
+        t_decode = time.time() if instrument else 0.0
         if decoding:
             slots = ecfg.max_decode_slots
             nb = ecfg.max_blocks_per_seq
@@ -315,8 +416,24 @@ class LLMEngine:
             self._current_rid = None
             self._decode_tokens += len(decoding)
             self._decode_slot_steps += ecfg.max_decode_slots
+            if instrument:
+                # One observation per batched decode dispatch, never per
+                # token — the whole emission loop rides in it.
+                self._h_step.observe(
+                    time.time() - t_decode, tags=self._step_tags["decode"]
+                )
 
         self._steps += 1
+        # A stepping engine exports its whole metric family: counters and
+        # histograms that happen not to fire after a registry reset (test
+        # isolation) must still re-register, or their series vanish from
+        # the exposition. One int compare each — nothing on the token path.
+        for metric in (
+            self._preemptions, self._prefix_hits, self._tokens_generated,
+            self._dead_letter_count, self._h_ttft, self._h_tpot,
+            self._h_queue, self._h_e2e, self._h_step,
+        ):
+            metric._ensure_registered()
         preempted = self.scheduler.num_preemptions - preempted_before
         if preempted:
             self._preemptions.inc(preempted, tags=self._metric_tags)
@@ -334,6 +451,28 @@ class LLMEngine:
         self._evictable_blocks.set(
             self.allocator.num_evictable, tags=self._metric_tags
         )
+        if instrument:
+            phase = "+".join(
+                p
+                for p, on in (("prefill", admitted), ("decode", decoding))
+                if on
+            ) or "idle"
+            self.flight_recorder.record_step(
+                {
+                    "step": self._steps - 1,
+                    "phase": phase,
+                    "batch_size": len(decoding),
+                    "num_prefills": len(admitted),
+                    "prefills": prefill_info,
+                    "tokens_in": sum(p["tokens"] for p in prefill_info),
+                    "tokens_out": len(admitted) + len(decoding),
+                    "cache_hit_tokens": step_hit_tokens,
+                    "preempted": preempted,
+                    "queue_depth": len(self.scheduler.waiting),
+                    "duration_s": round(time.time() - t_step, 6),
+                    "time": t_step,
+                }
+            )
         return {
             "num_prefilled": len(admitted),
             "num_decoding": len(decoding),
@@ -345,23 +484,37 @@ class LLMEngine:
             "evictable_blocks": self.allocator.num_evictable,
         }
 
-    def _run_prefills(self, admitted: List[Sequence]) -> int:
+    def _run_prefills(
+        self, admitted: List[Sequence], info_out: Optional[List[dict]] = None
+    ) -> int:
         """Run the prefill for each just-admitted sequence; returns the
-        prompt tokens served from the prefix cache this step."""
+        prompt tokens served from the prefix cache this step. With
+        instrumentation, `info_out` collects one record per prefill for the
+        flight recorder."""
+        instrument = self._instrument
         hit_tokens = 0
         for seq in admitted:
             # Per-sequence section: an exception below is attributable to
             # this request (LLMServer._loop fails only it and keeps going).
-            self._current_rid = seq.request.request_id
-            maybe_fail("llm.prefill", detail=seq.request.request_id)
+            rid = seq.request.request_id
+            self._current_rid = rid
+            maybe_fail("llm.prefill", detail=rid)
             offset = seq.num_cached  # tokens the admission matched in-cache
-            if seq.pending_copy is not None:
+            rt = queue_wait = None
+            if instrument:
+                t0 = time.time()
+                rt = self._req_traces.get(rid)
+                if rt is not None:
+                    queue_wait = rt.on_admitted(t0)
+            was_cow = seq.pending_copy is not None
+            if was_cow:
                 # Copy-on-write: the last matched block is shared and this
                 # prefill writes its final token's K/V into it.
                 src, dst = seq.pending_copy
                 seq.pending_copy = None
                 self.runner.copy_block(src, dst)
                 self.allocator.free([src])  # drop admission's copy-source ref
+            n_suffix = len(seq.prefill_ids) - offset
             if offset > 0:
                 first = self.runner.prefill_suffix(
                     seq.prefill_ids[offset:], seq.block_table, offset
@@ -373,6 +526,33 @@ class LLMEngine:
             seq.num_cached = len(seq.prefill_ids)
             self.scheduler.note_filled_blocks(seq)
             seq.generated.append(first)
+            if instrument:
+                t1 = time.time()
+                kind = "cow" if was_cow else ("partial" if offset else "full")
+                phase = "partial_prefill" if offset else "prefill"
+                bucket = self.engine_config.bucket_for(max(n_suffix, 1))
+                self._h_step.observe(t1 - t0, tags=self._step_tags[phase])
+                self._h_queue.observe(queue_wait or 0.0, tags=self._metric_tags)
+                if rt is not None:
+                    first_admission = rt.first_token_s is None
+                    rt.on_prefilled(
+                        t0, t1, kind, bucket, n_suffix, offset,
+                        len(seq.generated),
+                    )
+                    if first_admission:
+                        self._h_ttft.observe(
+                            t1 - rt.submit_s, tags=self._metric_tags
+                        )
+                if info_out is not None:
+                    info_out.append(
+                        {
+                            "request_id": rid,
+                            "kind": kind,
+                            "bucket": bucket,
+                            "tokens": n_suffix,
+                            "cached_tokens": offset,
+                        }
+                    )
             self._emit(seq)
             self._maybe_finish(seq)
         self._current_rid = None
@@ -398,9 +578,28 @@ class LLMEngine:
             self.scheduler.finish(seq, reason)
             self._finished(seq)
 
+    def _note_preempt(self, seq: Sequence) -> None:
+        """Scheduler preemption hook: close the victim's decode-stretch
+        span, mark the preemption, and restart its queue-wait clock."""
+        rt = self._req_traces.get(seq.request.request_id)
+        if rt is not None:
+            rt.on_preempt(time.time(), len(seq.generated))
+
     def _finished(self, seq: Sequence) -> None:
         req_id = seq.request.request_id
         self._on_token.pop(req_id, None)
+        rt = self._req_traces.pop(req_id, None)
+        if rt is not None:
+            now = time.time()
+            rt.on_finish(now, seq)
+            self._h_e2e.observe(now - rt.submit_s, tags=self._metric_tags)
+            n = len(seq.generated)
+            if rt.first_token_s is not None and n >= 2:
+                # Mean inter-token latency after the first token (TPOT);
+                # single-token requests have no decode interval to report.
+                self._h_tpot.observe(
+                    (now - rt.first_token_s) / (n - 1), tags=self._metric_tags
+                )
         cb = self._on_finish.pop(req_id, None)
         if cb is not None:
             cb(seq)
@@ -432,6 +631,7 @@ class LLMEngine:
     def stats(self) -> dict:
         elapsed = max(time.monotonic() - self._start, 1e-9)
         return {
+            "engine_id": self._metric_tags["engine"],
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
             "mean_occupancy": (
@@ -495,52 +695,16 @@ class LLMServer:
             # the actor is still initializing — a Serve deployment only
             # reports healthy afterwards, so cold-start compile never runs
             # under live traffic (nor under the controller's health probes).
-            ecfg = self._engine.engine_config
-            buckets = ecfg.buckets()
-            for bucket in buckets:
-                # Prompt length landing in this bucket, shaped so the whole
-                # request passes admission (lifetime within the largest
-                # bucket and max_model_len). 2 tokens when room allows: the
-                # second forces a decode step, compiling that program too.
-                n = bucket if bucket < buckets[-1] else bucket - 1
-                n = min(n, ecfg.max_model_len - 1)
-                budget = min(2, ecfg.max_model_len - n)
-                if n < 1:
-                    continue
-                # Each round must exercise the FULL prefill program: drop
-                # the previous round's cached zero-blocks, or this prompt
-                # would hit them and take the partial-prefill path, leaving
-                # this bucket's full program uncompiled.
-                self._engine.allocator.reset_prefix_cache()
-                try:
-                    self._engine.generate([[0] * n], max_new_tokens=budget)
-                except ValueError:
-                    # Bucket unwarmable under this config (e.g. the block
-                    # pool is smaller than the bucket); requests that large
-                    # are rejected at admission anyway.
-                    continue
-            if ecfg.enable_prefix_caching:
-                # Also compile every partial-prefill bucket and the
-                # copy-on-write block copy, so cache hits never trigger a
-                # cold compile under live traffic. Each round seeds exactly
-                # one cached block of zeros, then prefills a zero-prompt
-                # whose uncached suffix lands in the target bucket; the
-                # duplicate-prompt round at the end exercises the
-                # fully-cached path (CoW + smallest suffix bucket).
-                alloc = self._engine.allocator
-                bs = ecfg.block_size
-                for bucket in buckets + (0,):
-                    alloc.reset_prefix_cache()
-                    n = min(bs + bucket, ecfg.max_model_len - 1, buckets[-1])
-                    try:
-                        self._engine.generate([[0] * bs], max_new_tokens=1)
-                        if n > bs:
-                            self._engine.generate([[0] * n], max_new_tokens=1)
-                        else:  # CoW round: repeat the fully-cached prompt
-                            self._engine.generate([[0] * bs], max_new_tokens=1)
-                    except ValueError:
-                        continue
-                alloc.reset_prefix_cache()
+            # Warmup generations are NOT real requests: suppress per-request
+            # instrumentation so multi-second XLA compiles don't land in the
+            # TTFT/e2e SLO histograms or the trace buffer (the flight
+            # recorder's compile events capture warmup cost instead).
+            instrumented = self._engine._instrument
+            self._engine._instrument = False
+            try:
+                self._warmup()
+            finally:
+                self._engine._instrument = instrumented
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._requests: Dict[str, _RequestState] = {}
@@ -551,6 +715,67 @@ class LLMServer:
             target=self._loop, name="llm-engine-loop", daemon=True
         )
         self._thread.start()
+
+    def _warmup(self) -> None:
+        ecfg = self._engine.engine_config
+        buckets = ecfg.buckets()
+        for bucket in buckets:
+            # Prompt length landing in this bucket, shaped so the whole
+            # request passes admission (lifetime within the largest
+            # bucket and max_model_len). 2 tokens when room allows: the
+            # second forces a decode step, compiling that program too.
+            n = bucket if bucket < buckets[-1] else bucket - 1
+            n = min(n, ecfg.max_model_len - 1)
+            budget = min(2, ecfg.max_model_len - n)
+            if n < 1:
+                continue
+            # Each round must exercise the FULL prefill program: drop
+            # the previous round's cached zero-blocks, or this prompt
+            # would hit them and take the partial-prefill path, leaving
+            # this bucket's full program uncompiled.
+            self._engine.allocator.reset_prefix_cache()
+            t0 = time.monotonic()
+            try:
+                self._engine.generate([[0] * n], max_new_tokens=budget)
+            except ValueError:
+                # Bucket unwarmable under this config (e.g. the block
+                # pool is smaller than the bucket); requests that large
+                # are rejected at admission anyway.
+                continue
+            # Cold-compile blame: almost all of this round is XLA
+            # compiling the bucket's full-prefill program (plus, on the
+            # first round, the decode program).
+            self._engine.flight_recorder.record_compile(
+                "prefill", bucket, time.monotonic() - t0
+            )
+        if ecfg.enable_prefix_caching:
+            # Also compile every partial-prefill bucket and the
+            # copy-on-write block copy, so cache hits never trigger a
+            # cold compile under live traffic. Each round seeds exactly
+            # one cached block of zeros, then prefills a zero-prompt
+            # whose uncached suffix lands in the target bucket; the
+            # duplicate-prompt round at the end exercises the
+            # fully-cached path (CoW + smallest suffix bucket).
+            alloc = self._engine.allocator
+            bs = ecfg.block_size
+            for bucket in buckets + (0,):
+                alloc.reset_prefix_cache()
+                n = min(bs + bucket, ecfg.max_model_len - 1, buckets[-1])
+                t0 = time.monotonic()
+                try:
+                    self._engine.generate([[0] * bs], max_new_tokens=1)
+                    if n > bs:
+                        self._engine.generate([[0] * n], max_new_tokens=1)
+                    else:  # CoW round: repeat the fully-cached prompt
+                        self._engine.generate([[0] * bs], max_new_tokens=1)
+                except ValueError:
+                    continue
+                self._engine.flight_recorder.record_compile(
+                    "cow" if n <= bs else "partial_prefill",
+                    0 if n <= bs else bucket,
+                    time.monotonic() - t0,
+                )
+            alloc.reset_prefix_cache()
 
     # ---------------- engine loop ----------------
 
@@ -577,6 +802,8 @@ class LLMServer:
                     # max_consecutive_step_failures=1 would disable
                     # isolation entirely).
                     culprit = self._engine.culprit_for(exc)
+                    recorder = self._engine.flight_recorder
+                    step_idx = self._engine._steps
                     if culprit is not None:
                         # Poison-request isolation: fail only the culpable
                         # request (dead-letter + KV release) and keep
@@ -595,8 +822,15 @@ class LLMServer:
                             # toward the wedge threshold (a stream of poison
                             # requests must not take down the replica).
                             self._consecutive_step_failures = 0
+                        recorder.record_failure(
+                            step_idx, repr(exc), request_id=culprit,
+                            action="dead_letter",
+                        )
                         continue
                     if self._consecutive_step_failures < max_failures:
+                        recorder.record_failure(
+                            step_idx, repr(exc), action="retry"
+                        )
                         # Unattributable failure (e.g. the batched decode
                         # program itself): per-sequence state only mutates
                         # after the risky calls return, so retrying the
@@ -608,8 +842,12 @@ class LLMServer:
                     # error broadcast and the thread actually dying; the
                     # Serve controller's next health probe then replaces
                     # the replica.
+                    recorder.record_failure(
+                        step_idx, repr(exc), action="wedged"
+                    )
                     self._wedged = True
                     self._shutdown = True
+                    self._engine.close_traces(exc)
                     for state in self._requests.values():
                         if not state.done.is_set():
                             state.error = exc
@@ -741,6 +979,33 @@ class LLMServer:
         with self._lock:
             return self._engine.dead_letters()
 
+    def flight_record(self, steps_limit: Optional[int] = None) -> dict:
+        """The engine flight recorder: bounded rings of per-step records
+        (phase, batch size, tokens, buckets, cache hits, preemptions,
+        duration), warmup compile events (cold-compile blame), and step
+        failures with the action taken (dead_letter / retry / wedged)."""
+        with self._lock:
+            return self._engine.flight_recorder.snapshot(steps_limit)
+
+    def observability_snapshot(
+        self, steps_limit: Optional[int] = None
+    ) -> dict:
+        """metrics + dead letters + flight recorder in ONE actor round trip
+        (the dashboard /api/llm panel polls this; three separate RPCs per
+        engine per refresh would triple the scrape's exposure to a busy
+        engine's lock)."""
+        with self._lock:
+            stats = self._engine.stats()
+            stats["wedged"] = self._wedged
+            stats["consecutive_step_failures"] = self._consecutive_step_failures
+            return {
+                "metrics": stats,
+                "dead_letters": self._engine.dead_letters(),
+                "flight_record": self._engine.flight_recorder.snapshot(
+                    steps_limit
+                ),
+            }
+
     def reset_prefix_cache(self) -> None:
         """Drop all cached-but-unreferenced KV blocks (e.g. after swapping
         the served params, whose cached activations would be stale)."""
@@ -762,6 +1027,8 @@ class LLMServer:
             # Fail in-flight requests promptly instead of leaving their
             # callers to run out their full wait timeout.
             exc = RuntimeError("LLM engine shut down with requests in flight")
+            if self._requests:
+                self._engine.close_traces(exc)
             for state in self._requests.values():
                 if not state.done.is_set():
                     state.error = exc

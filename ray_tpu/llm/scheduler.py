@@ -100,6 +100,11 @@ class Scheduler:
         self._active: Dict[str, Sequence] = {}  # request_id -> waiting|running
         self.num_preemptions = 0
         self.num_cow_blocks = 0
+        # Observability hook: called with the victim right after it re-enters
+        # the waiting queue (engine closes its decode-stretch span and
+        # restarts its queue-wait clock). Fires only on preemption, so the
+        # steady-state decode path pays nothing for it.
+        self.on_preempt = None
 
     # ---------------- queue management ----------------
 
@@ -234,6 +239,8 @@ class Scheduler:
         seq.num_preemptions += 1
         self.num_preemptions += 1
         self.waiting.appendleft(seq)
+        if self.on_preempt is not None:
+            self.on_preempt(seq)
 
     def finish(self, seq: Sequence, reason: str) -> None:
         self.running.remove(seq)

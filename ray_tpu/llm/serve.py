@@ -125,6 +125,11 @@ class LLMIngress:
         engine step (see LLMServer.dead_letters)."""
         return ray_tpu.get(self._engine.dead_letters.remote())
 
+    def flight_record(self, steps_limit: Optional[int] = None) -> dict:
+        """The engine flight recorder (see LLMServer.flight_record):
+        per-step records, warmup compile events, and step failures."""
+        return ray_tpu.get(self._engine.flight_record.remote(steps_limit))
+
     def reset_prefix_cache(self) -> None:
         """Drop the engine's cached-but-unreferenced KV blocks (call after
         swapping served params, whose cached activations would be stale)."""

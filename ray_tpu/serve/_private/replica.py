@@ -15,6 +15,7 @@ import time
 from typing import Any
 
 from ray_tpu._private.fault_injection import maybe_fail
+from ray_tpu.util import tracing
 
 
 class ReplicaActor:
@@ -87,9 +88,20 @@ class ReplicaActor:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name)
-            result = target(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+            # User-callable execution time as its own span (the enclosing
+            # task span also covers argument resolution and queueing);
+            # submissions made inside the callable nest under it.
+            with tracing.span(
+                "serve.replica.request",
+                {
+                    "deployment": self._deployment_name,
+                    "replica": self._replica_tag,
+                    "method": method_name,
+                },
+            ):
+                result = target(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
             return result
         finally:
             from ray_tpu.serve.multiplex import _multiplexed_model_id
@@ -119,6 +131,12 @@ class ReplicaActor:
         with self._lock:
             self._num_ongoing += 1
         token = _set_multiplexed_model_id(multiplexed_model_id)
+        # Stream processing span, emitted with an EXPLICIT parent at the
+        # end (a `with` span inside a generator would reset contextvars
+        # from whatever thread happens to finalize the frame).
+        span_parent = tracing.capture_context()
+        span_start = time.time()
+        n_items = 0
         try:
             if method_name == "__call__":
                 target = self._callable
@@ -134,15 +152,18 @@ class ReplicaActor:
                 try:
                     while True:
                         try:
-                            yield loop.run_until_complete(result.__anext__())
+                            item = loop.run_until_complete(result.__anext__())
                         except StopAsyncIteration:
                             break
+                        n_items += 1
+                        yield item
                 finally:
                     loop.close()
                 return
             if not hasattr(result, "__iter__") or isinstance(
                 result, (str, bytes, dict)
             ):
+                n_items = 1
                 yield result  # non-iterable: a one-item stream
                 return
             for item in result:
@@ -152,10 +173,23 @@ class ReplicaActor:
                     "replica.stream_item",
                     detail=f"{self._deployment_name}:{self._replica_tag}",
                 )
+                n_items += 1
                 yield item
         finally:
             from ray_tpu.serve.multiplex import _multiplexed_model_id
 
+            tracing.emit_span(
+                "serve.replica.stream",
+                span_start,
+                time.time(),
+                parent=span_parent,
+                attributes={
+                    "deployment": self._deployment_name,
+                    "replica": self._replica_tag,
+                    "method": method_name,
+                    "items": n_items,
+                },
+            )
             _multiplexed_model_id.reset(token)
             with self._lock:
                 self._num_ongoing -= 1

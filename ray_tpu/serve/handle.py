@@ -19,6 +19,7 @@ raw ActorDiedError.
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -37,6 +38,8 @@ from ray_tpu.serve.config import (
     DEFAULT_BACKOFF_INITIAL_S,
     DEFAULT_RETRY_BUDGET,
 )
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, get_or_create
 
 # Replica failures the router fails over; everything else (user exceptions,
 # timeouts) surfaces to the caller untouched.
@@ -218,6 +221,7 @@ class DeploymentResponseGenerator:
                 return None
             delay = self._router.plan_retry(self._ctx, exc)
             self._ctx.args, self._ctx.kwargs = resumed
+            self._router.note_stream_resume()
             # Items already folded into the re-submission must not be
             # folded again by a later failover: the next resume is
             # relative to the updated args.
@@ -310,6 +314,35 @@ class Router:
             else backoff_initial_s
         )
         self._handle_id = uuid.uuid4().hex[:12]
+        # Failover observability (PR 3 shipped the behavior with no
+        # metrics): every router shares one registered counter per name,
+        # with the deployment as the series tag.
+        self._dep_tags = {"deployment": deployment}
+        self._m_retries = get_or_create(
+            Counter,
+            "serve_router_retry_dispatches",
+            "Failover re-dispatches after a retryable replica failure",
+            tag_keys=("deployment",),
+        )
+        self._m_excluded = get_or_create(
+            Counter,
+            "serve_router_excluded_replicas",
+            "Replica exclusions recorded against failing requests",
+            tag_keys=("deployment",),
+        )
+        self._m_resumes = get_or_create(
+            Counter,
+            "serve_router_stream_resumes",
+            "Mid-stream failovers resumed via a stream_resume_fn",
+            tag_keys=("deployment",),
+        )
+        self._m_exhausted = get_or_create(
+            Counter,
+            "serve_router_retry_exhausted",
+            "Requests that spent their retry budget "
+            "(ReplicaUnavailableRetryExhausted)",
+            tag_keys=("deployment",),
+        )
         self._lock = threading.Condition()
         self._replicas: dict[str, Any] = {}
         self._in_flight: dict[str, int] = {}
@@ -400,10 +433,29 @@ class Router:
     def dispatch(self, ctx: _RequestContext, stream: bool):
         """Pick a replica and submit `ctx`'s request; a submit-time replica
         failure backs off and retries within the request's budget. Returns
-        the raw ObjectRef (or ref generator for streams)."""
+        the raw ObjectRef (or ref generator for streams).
+
+        A re-dispatch after a failure (ctx.failures > 0 — submit-time
+        retries, response-side failover, and mid-stream resumes all funnel
+        through here) is wrapped in a "serve.retry" span, so the retried
+        replica task shows up in the trace as a child of the retry, sibling
+        to the failed attempt."""
         while True:
+            span = (
+                tracing.span(
+                    "serve.retry",
+                    {
+                        "deployment": self._deployment,
+                        "method": ctx.method_name,
+                        "attempt": ctx.failures,
+                    },
+                )
+                if ctx.failures
+                else contextlib.nullcontext()
+            )
             try:
-                return self._dispatch_once(ctx, stream)
+                with span:
+                    return self._dispatch_once(ctx, stream)
             except RETRYABLE_ERRORS as exc:
                 time.sleep(self.plan_retry(ctx, exc))
 
@@ -411,19 +463,27 @@ class Router:
         """Account one failed dispatch attempt: exclude the replica it
         landed on and compute the exponential backoff delay. Raises the
         typed ReplicaUnavailableRetryExhausted once the budget is spent."""
-        if ctx.tag is not None:
+        if ctx.tag is not None and ctx.tag not in ctx.excluded:
             ctx.excluded.add(ctx.tag)
+            self._m_excluded.inc(tags=self._dep_tags)
         ctx.failures += 1
         if ctx.failures > self._retry_budget:
+            self._m_exhausted.inc(tags=self._dep_tags)
             raise ReplicaUnavailableRetryExhausted(
                 deployment=self._deployment,
                 attempts=ctx.failures,
                 last_error=exc,
             ) from exc
+        self._m_retries.inc(tags=self._dep_tags)
         return min(
             self._backoff_initial_s * BACKOFF_MULTIPLIER ** (ctx.failures - 1),
             BACKOFF_MAX_S,
         )
+
+    def note_stream_resume(self) -> None:
+        """One mid-stream failover actually resumed (items already
+        delivered were folded into a re-submission)."""
+        self._m_resumes.inc(tags=self._dep_tags)
 
     def _dispatch_once(self, ctx: _RequestContext, stream: bool):
         with self._lock:

@@ -15,6 +15,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: Dict[str, "Metric"] = {}
+# Bumped by reset_registry(); metrics re-register lazily on their next write
+# when their registration generation is stale (see Metric._ensure_registered).
+_REGISTRY_GEN = 0
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
@@ -43,10 +46,25 @@ class Metric:
                     f"Metric {name!r} already registered as {existing.kind}"
                 )
             _REGISTRY[name] = self
+            self._reg_gen = _REGISTRY_GEN
 
     def set_default_tags(self, tags: dict) -> "Metric":
         self._default_tags = dict(tags)
         return self
+
+    def _ensure_registered(self) -> None:
+        """Re-register after reset_registry() wiped the exposition table, so
+        long-lived holders (an engine that outlives a test's reset) keep
+        exporting on their next write. First writer wins: if a FRESH metric
+        of this name registered since the reset (the common get_or_create
+        path in a new test), it keeps the name and this instance's writes
+        simply stop being exported — series never flip-flop between
+        instances. One int compare on the hot path."""
+        if self._reg_gen == _REGISTRY_GEN:
+            return
+        with _REGISTRY_LOCK:
+            _REGISTRY.setdefault(self.name, self)
+            self._reg_gen = _REGISTRY_GEN
 
     def _merged(self, tags: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
         merged = dict(self._default_tags)
@@ -71,6 +89,7 @@ class Counter(Metric):
     def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
         if value < 0:
             raise ValueError("Counters only increase")
+        self._ensure_registered()
         key = self._merged(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
@@ -88,10 +107,12 @@ class Gauge(Metric):
         self._values: Dict[tuple, float] = {}
 
     def set(self, value: float, tags: Optional[dict] = None) -> None:
+        self._ensure_registered()
         with self._lock:
             self._values[self._merged(tags)] = float(value)
 
     def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        self._ensure_registered()
         key = self._merged(tags)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
@@ -121,6 +142,7 @@ class Histogram(Metric):
         self._counts: Dict[tuple, int] = {}
 
     def observe(self, value: float, tags: Optional[dict] = None) -> None:
+        self._ensure_registered()
         key = self._merged(tags)
         with self._lock:
             buckets = self._buckets.setdefault(
@@ -208,7 +230,19 @@ def get_or_create(kind_cls, name: str, description: str = "", **kwargs):
     return kind_cls(name, description, **kwargs)
 
 
-def clear_registry() -> None:
-    """Test helper."""
+def reset_registry() -> None:
+    """Drop every registered metric — test isolation between tests that
+    construct multiple engines/routers in one process, so histogram
+    tag-sets and counter values don't bleed from one test's exposition
+    into the next. Surviving metric INSTANCES keep working: the first one
+    to write after a reset re-registers itself (Metric._ensure_registered),
+    while get_or_create() in later code sees an empty slot and builds a
+    fresh zero-valued metric."""
+    global _REGISTRY_GEN
     with _REGISTRY_LOCK:
         _REGISTRY.clear()
+        _REGISTRY_GEN += 1
+
+
+# Backwards-compatible alias (same semantics).
+clear_registry = reset_registry

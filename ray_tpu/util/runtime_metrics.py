@@ -197,6 +197,100 @@ def sample_runtime_metrics(runtime) -> None:
         g["resources_available"].set(value, tags={"resource": key})
 
 
+def list_llm_engine_actors(runtime) -> list:
+    """Live named LLM engine actors (llm.serve names them
+    "llm_engine:<name>"), as (name, namespace) pairs."""
+    out = []
+    for record in runtime.controller.list_actors():
+        name = getattr(record, "name", None)
+        if (
+            name
+            and name.startswith("llm_engine:")
+            and record.state.value == "ALIVE"
+        ):
+            out.append((name, record.namespace))
+    return out
+
+
+def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
+    """Scrape-time freshness for the LLM engine gauges: the engine only
+    updates them when it steps, so an idle engine's queue-depth /
+    cache-utilization / hit-rate series would otherwise freeze at their
+    last-step values. Pulls LLMServer.metrics() from every live named
+    engine actor and rewrites the engine-tagged series (stats carry the
+    engine's own metric tag id), plus a dead-letter-count gauge. Failures
+    are swallowed — a slow engine must never break the /metrics scrape."""
+    from ray_tpu.util.metrics import get_or_create
+
+    engines = list_llm_engine_actors(runtime)
+    if not engines:
+        return
+    import ray_tpu
+
+    gauges = {
+        "queue_depth": get_or_create(
+            Gauge,
+            "llm_engine_queue_depth",
+            "Requests waiting for a decode slot",
+            tag_keys=("engine",),
+        ),
+        "cache_utilization": get_or_create(
+            Gauge,
+            "llm_engine_cache_utilization",
+            "Allocated KV blocks / usable",
+            tag_keys=("engine",),
+        ),
+        "prefix_cache_hit_rate": get_or_create(
+            Gauge,
+            "llm_engine_prefix_cache_hit_rate",
+            "Cumulative prefix-cache hit tokens / prefill tokens",
+            tag_keys=("engine",),
+        ),
+        "evictable_blocks": get_or_create(
+            Gauge,
+            "llm_engine_evictable_blocks",
+            "Cached-but-unreferenced KV blocks (reusable until evicted)",
+            tag_keys=("engine",),
+        ),
+    }
+    dead_letters = get_or_create(
+        Gauge,
+        "llm_engine_dead_letters",
+        "Dead-letter records currently retained by the engine",
+        tag_keys=("engine",),
+    )
+    wedged = get_or_create(
+        Gauge,
+        "llm_engine_wedged",
+        "1 when the engine declared itself wedged",
+        tag_keys=("engine",),
+    )
+    # Fire every engine's RPC first, then collect against ONE shared
+    # deadline: a slow/wedged engine costs the scrape at most timeout_s
+    # total, not timeout_s per engine.
+    pending = []
+    for name, namespace in engines:
+        try:
+            handle = ray_tpu.get_actor(name, namespace=namespace)
+            pending.append((name, handle.metrics.remote()))
+        except Exception:
+            continue
+    deadline = time.monotonic() + timeout_s
+    for name, ref in pending:
+        try:
+            stats = ray_tpu.get(
+                ref, timeout=max(deadline - time.monotonic(), 0.05)
+            )
+            tags = {"engine": stats.get("engine_id") or name}
+            for key, gauge in gauges.items():
+                if key in stats:
+                    gauge.set(float(stats[key]), tags=tags)
+            dead_letters.set(float(stats.get("num_dead_letters", 0)), tags=tags)
+            wedged.set(1.0 if stats.get("wedged") else 0.0, tags=tags)
+        except Exception:
+            continue
+
+
 class RuntimeMetricsSampler:
     """Background refresher (the reporter-agent analog)."""
 

@@ -177,6 +177,50 @@ def span(name: str, attributes: Optional[dict] = None):
         _buffer.add(record)
 
 
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def emit_span(
+    name: str,
+    start_s: float,
+    end_s: float,
+    *,
+    parent: Optional[tuple] = None,
+    trace_id: Optional[str] = None,
+    parent_span_id: Optional[str] = None,
+    span_id: Optional[str] = None,
+    attributes: Optional[dict] = None,
+) -> Span:
+    """Record a finished span with an EXPLICIT context instead of the
+    ambient one. This is the emission path for background threads that run
+    outside any task context (e.g. the LLM engine step loop): the component
+    captures `capture_context()` once at request submission and later emits
+    phase spans against it from whatever thread does the work, with no
+    contextvar churn and no allocation until the phase actually ends.
+
+    `parent` is a (trace_id, span_id) tuple as returned by
+    `capture_context()`; `trace_id`/`parent_span_id` override it piecewise
+    (pass `parent_span_id` to chain emitted spans under each other). With
+    neither, the span becomes its own trace root."""
+    if parent is not None:
+        trace_id = trace_id or parent[0]
+        if parent_span_id is None:
+            parent_span_id = parent[1]
+    record = Span(
+        trace_id=trace_id or uuid.uuid4().hex[:16],
+        span_id=span_id or new_span_id(),
+        parent_span_id=parent_span_id,
+        name=name,
+        start_s=start_s,
+        end_s=end_s,
+        attributes=dict(attributes or {}),
+        owner_task=_ambient_task.get(),
+    )
+    _buffer.add(record)
+    return record
+
+
 def local_spans() -> List[dict]:
     """Finished user spans recorded in THIS process."""
     return [s.to_dict() for s in _buffer.snapshot()]

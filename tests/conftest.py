@@ -27,6 +27,19 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
+@pytest.fixture(autouse=True)
+def _metrics_registry_isolation():
+    """Metric isolation between tests: histogram tag-sets and counter
+    values must not bleed from one test's engines/routers into the next
+    test's prometheus_text(). Resetting AFTER each test leaves the registry
+    empty for the next one; long-lived holders (module-scoped engines)
+    re-register lazily on their next write (util.metrics.reset_registry)."""
+    yield
+    from ray_tpu.util import metrics
+
+    metrics.reset_registry()
+
+
 @pytest.fixture
 def ray_start_regular():
     """Single-node runtime, 4 CPUs (reference: tests/conftest.py:351)."""

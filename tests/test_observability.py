@@ -205,6 +205,82 @@ def test_histogram_boundary_inclusive():
     assert 'bound_s_bucket{le="0.1"} 1' in text
 
 
+def test_histogram_exposition_buckets_sum_count():
+    """Prometheus histogram exposition correctness: per-bucket cumulative
+    counts, `le` boundaries in ascending order ending at +Inf, and exact
+    _sum/_count lines."""
+    metrics.clear_registry()
+    h = metrics.Histogram("exp_s", "latency", boundaries=[0.25, 1.0, 4.0])
+    for v in (0.125, 0.5, 0.5, 2.0, 8.0):  # binary-exact: sum is too
+        h.observe(v)
+    text = metrics.prometheus_text()
+    lines = [l for l in text.splitlines() if l.startswith("exp_s")]
+    # Cumulative counts at each boundary: ≤0.25 → 1, ≤1.0 → 3, ≤4.0 → 4,
+    # +Inf → 5.
+    assert 'exp_s_bucket{le="0.25"} 1' in lines
+    assert 'exp_s_bucket{le="1.0"} 3' in lines
+    assert 'exp_s_bucket{le="4.0"} 4' in lines
+    assert 'exp_s_bucket{le="+Inf"} 5' in lines
+    # le ordering as rendered: ascending, +Inf last, counts monotone.
+    les, counts = [], []
+    for line in lines:
+        if "_bucket" in line:
+            les.append(line.split('le="')[1].split('"')[0])
+            counts.append(int(line.rsplit(" ", 1)[1]))
+    assert les == ["0.25", "1.0", "4.0", "+Inf"]
+    assert counts == sorted(counts)
+    assert "exp_s_sum 11.125" in text
+    assert "exp_s_count 5" in text
+    assert "# TYPE exp_s histogram" in text
+
+
+def test_histogram_exposition_tagged_series_independent():
+    """Tagged histogram series render independently: each tag-set gets its
+    own _bucket/_sum/_count family, with the le label merged into the
+    series tags."""
+    metrics.clear_registry()
+    h = metrics.Histogram(
+        "tag_s", "latency", boundaries=[0.1, 1.0], tag_keys=("route",)
+    )
+    h.observe(0.05, tags={"route": "a"})
+    h.observe(0.5, tags={"route": "a"})
+    h.observe(2.0, tags={"route": "b"})
+    text = metrics.prometheus_text()
+    assert 'tag_s_bucket{le="0.1",route="a"} 1' in text
+    assert 'tag_s_bucket{le="1.0",route="a"} 2' in text
+    assert 'tag_s_bucket{le="+Inf",route="a"} 2' in text
+    assert 'tag_s_bucket{le="1.0",route="b"} 0' in text
+    assert 'tag_s_bucket{le="+Inf",route="b"} 1' in text
+    assert 'tag_s_count{route="a"} 2' in text
+    assert 'tag_s_count{route="b"} 1' in text
+    assert 'tag_s_sum{route="b"} 2.0' in text
+
+
+def test_reset_registry_isolates_and_reregisters_survivors():
+    """reset_registry() empties the exposition (get_or_create then builds
+    fresh zero-valued metrics — no value bleed between tests), while a
+    surviving instance re-registers itself on its next write instead of
+    silently vanishing — unless a fresh instance took the name first."""
+    metrics.reset_registry()
+    old = metrics.get_or_create(metrics.Counter, "iso_total", "x")
+    old.inc(5)
+    assert "iso_total 5.0" in metrics.prometheus_text()
+    metrics.reset_registry()
+    assert "iso_total" not in metrics.prometheus_text()
+    # A new get_or_create after reset builds a fresh zero-valued metric.
+    fresh = metrics.get_or_create(metrics.Counter, "iso_total", "x")
+    assert fresh is not old
+    fresh.inc(1)
+    assert "iso_total 1.0" in metrics.prometheus_text()
+    # The survivor keeps counting but cannot evict the fresh registrant.
+    old.inc(1)
+    assert "iso_total 1.0" in metrics.prometheus_text()
+    # With no fresh claimant, the survivor re-registers on write.
+    metrics.reset_registry()
+    old.inc(1)
+    assert "iso_total 7.0" in metrics.prometheus_text()
+
+
 def test_metrics_label_escaping():
     metrics.clear_registry()
     c = metrics.Counter("esc_total", tag_keys=("k",))
