@@ -35,7 +35,13 @@ class Checkpoint:
 
     @classmethod
     def from_dict(cls, data: dict) -> "Checkpoint":
-        return cls(data=dict(data))
+        # Train-profiler hook: checkpoint construction inside an
+        # instrumented training session counts as the round's `checkpoint`
+        # phase (and is the per-rank fault-injection site train.checkpoint).
+        from ray_tpu.train.observability import phase_or_null
+
+        with phase_or_null("checkpoint"):
+            return cls(data=dict(data))
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":

@@ -52,6 +52,29 @@ class FailureConfig:
 
 
 @dataclass
+class TrainConfig:
+    """Training-plane knobs (the `EngineConfig.instrument` mirror).
+
+    instrument: per-round step profiling, `train.*` spans, `train_*`
+        histograms, straggler detection, and the run registry the dashboard
+        `/api/train` panel reads. Off compiles the whole plane out of the
+        report path (sessions get no profiler, hooks see None).
+    straggler_factor/straggler_min_s: a rank is flagged when its non-report
+        work time exceeds the low-median across ranks by `straggler_factor`
+        AND by at least `straggler_min_s` (absolute floor so near-zero
+        rounds don't flag on noise).
+    profiler_capacity: per-worker round-record ring size.
+    rounds_capacity: driver-side per-run round-record ring size.
+    """
+
+    instrument: bool = True
+    straggler_factor: float = 2.0
+    straggler_min_s: float = 0.05
+    profiler_capacity: int = 512
+    rounds_capacity: int = 256
+
+
+@dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None

@@ -16,6 +16,10 @@ class Result:
     path: Optional[str] = None
     metrics_history: list[dict] = field(default_factory=list)
     config: dict = field(default_factory=dict)
+    # Training telemetry snapshot (TrainConfig.instrument): per-phase
+    # min/median/max across ranks, round records, straggler report. None
+    # when instrumentation is off or the trainer doesn't profile.
+    train_report: Optional[dict] = None
 
     @property
     def best_checkpoint(self) -> Optional[Checkpoint]:

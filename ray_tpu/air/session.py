@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
@@ -41,10 +42,13 @@ class _Session:
     FINISHED = object()
 
     def __init__(self, context: TrainContext, checkpoint: Optional[Checkpoint],
-                 dataset_shards: Optional[dict] = None):
+                 dataset_shards: Optional[dict] = None, profiler=None):
         self.context = context
         self.loaded_checkpoint = checkpoint
         self.dataset_shards = dataset_shards or {}
+        # train.observability.StepProfiler when TrainConfig.instrument is on;
+        # None compiles the telemetry plane out of report()/the hook sites.
+        self.profiler = profiler
         # 1-deep rendezvous: report() blocks until the driver consumes.
         self.result_queue: "queue.Queue" = queue.Queue(maxsize=1)
         self.stop_event = threading.Event()
@@ -52,7 +56,18 @@ class _Session:
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint]) -> None:
         if self.stop_event.is_set():
             raise StopIteration("Training stopped by the driver")
-        self.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        item = {"metrics": dict(metrics), "checkpoint": checkpoint}
+        profiler = self.profiler
+        if profiler is not None:
+            # Close the round just before the rendezvous so its record
+            # rides this report; the put's blocking time is attributed to
+            # the NEXT round's `report` phase (it is that round's start).
+            item["profile"] = profiler.end_round()
+            t0 = time.perf_counter()
+            self.result_queue.put(item)
+            profiler.add("report", time.perf_counter() - t0)
+        else:
+            self.result_queue.put(item)
         if self.stop_event.is_set():
             raise StopIteration("Training stopped by the driver")
 
@@ -90,10 +105,18 @@ def get_checkpoint() -> Optional[Checkpoint]:
 
 
 def get_dataset_shard(name: str = "train"):
-    shards = _require_session().dataset_shards
+    session = _require_session()
+    shards = session.dataset_shards
     if name not in shards:
         raise KeyError(f"No dataset shard named {name!r}; have {list(shards)}")
-    return shards[name]
+    shard = shards[name]
+    # Instrumented sessions see the shard through a data_wait clock; list
+    # shards (already materialized, nothing to wait on) pass through.
+    if session.profiler is not None and hasattr(shard, "iter_batches"):
+        from ray_tpu.train.observability import ProfiledDataIterator
+
+        return ProfiledDataIterator(shard, session.profiler)
+    return shard
 
 
 def get_world_rank() -> int:

@@ -268,12 +268,17 @@ def available_resources() -> dict[str, float]:
 
 
 def timeline(filename: Optional[str] = None):
-    """Chrome-trace timeline of task executions (reference:
-    ray.timeline, _private/state.py:831 backed by GCS profile events; here
-    backed by the runtime's task-event buffer). Returns the trace records,
-    and writes them as JSON when `filename` is given — load in
-    chrome://tracing or Perfetto."""
-    events = get_runtime().task_events.chrome_trace()
+    """Chrome-trace timeline of task executions AND buffered tracing spans
+    (reference: ray.timeline, _private/state.py:831 backed by GCS profile
+    events; here backed by the runtime's task-event buffer plus the span
+    buffer, so `llm.*` serving and `train.*` training spans appear on the
+    same timeline as their tasks). Returns the trace records, and writes
+    them as JSON when `filename` is given — load in chrome://tracing or
+    Perfetto."""
+    from ray_tpu.util import tracing
+
+    runtime = get_runtime()
+    events = runtime.task_events.chrome_trace() + tracing.chrome_spans(runtime)
     if filename:
         import json
 

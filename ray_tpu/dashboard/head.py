@@ -18,10 +18,13 @@ Endpoints:
   GET /api/placement_groups  state API list_placement_groups
   GET /api/task_summary      per-(name,state) counts
   GET /api/logs[?node_id=&wid=&after_seq=&limit=]   log buffer tail
-  GET /api/timeline          chrome://tracing JSON of task events
+  GET /api/timeline          chrome://tracing JSON of task events + buffered
+                             tracing spans (serving + training rows)
   GET /api/metrics_history[?limit=&since=]   gauge-suite timeseries ring
   GET /api/llm[?steps=]      LLM engine panel: stats, flight recorder,
                              dead letters, per named engine actor
+  GET /api/train[?rounds=]   training-run panel: round records, per-phase
+                             breakdown, straggler flags, per recent fit()
   GET /metrics               prometheus text exposition (runtime gauges AND
                              LLM engine gauges refreshed at scrape time)
 """
@@ -54,6 +57,7 @@ _PAGE = """<!doctype html>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Task summary</h2><table id="tasks"></table>
 <h2>LLM engines</h2><div id="llm">none</div>
+<h2>Train runs</h2><div id="train">none</div>
 <h2>History <span id="hist_legend" style="font-size:.75rem;font-weight:normal"></span></h2>
 <canvas id="hist" width="900" height="160"
   style="background:#fff;border:1px solid #ddd;width:100%;max-width:900px"></canvas>
@@ -110,6 +114,32 @@ function renderLLM(engines){
       (fails?`<ul style="font-size:.8rem">${fails}</ul>`:'');
   }).join('<hr>');
 }
+function renderTrain(runs){
+  const el=document.getElementById('train');
+  if(!runs.length){el.textContent='none';return}
+  el.innerHTML=runs.map(r=>{
+    const ps=r.phase_stats||{};
+    const phases=Object.entries(ps).map(([p,s])=>
+      `${esc(p)} ${(1e3*s.median).toFixed(1)}ms`).join(' · ');
+    const head=`<p><b class=mono>${esc(r.name)}</b> [${esc(r.run_id)}] · `+
+      `${r.error?'<span class=bad>'+esc(r.error)+'</span>'
+               :(r.finished?'<span class=ok>finished</span>':'running')} · `+
+      `${r.num_workers} workers · rounds ${r.rounds_total} · `+
+      `samples ${r.samples_total} · `+
+      `straggler rounds ${r.straggler_rounds?'<span class=bad>'+r.straggler_rounds+'</span>':'0'}`+
+      `</p><p style="font-size:.8rem">phase medians: ${phases||'n/a'}</p>`;
+    const rounds=(r.rounds||[]).slice(-8).map(x=>
+      `<tr><td>${x.round}</td><td>${(1e3*x.duration_s).toFixed(1)}ms</td>`+
+      `<td>${x.samples}</td>`+
+      `<td>${Object.entries(x.phase_stats||{}).map(([p,s])=>
+          `${esc(p)} ${(1e3*s.max).toFixed(1)}`).join(' ')}</td>`+
+      `<td>${(x.stragglers||[]).map(s=>
+          `<span class=bad>rank ${s.rank}: ${esc(s.phase)}</span>`).join(' ')||'—'}</td></tr>`).join('');
+    const table=rounds?`<table><tr><th>round</th><th>wall</th><th>samples</th>`+
+      `<th>phase max (ms)</th><th>stragglers</th></tr>${rounds}</table>`:'';
+    return head+table;
+  }).join('<hr>');
+}
 function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
   .replace(/>/g,'&gt;').replace(/"/g,'&quot;')}
 function fill(id, rows, cols){
@@ -134,6 +164,7 @@ async function refresh(){
     const s=await j('/api/task_summary');
     fill('tasks', Object.entries(s).map(([k,v])=>({task:k,count:v})));
     renderLLM(await j('/api/llm?steps=12'));
+    renderTrain(await j('/api/train?rounds=8'));
     const logs=await j('/api/logs?limit=200');
     document.getElementById('logs').textContent=
       logs.map(l=>`(pid=${l.pid}, node=${l.hostname}) ${l.line}`).join('\\n');
@@ -263,7 +294,12 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             )
         elif path == "/api/timeline":
-            self._json(runtime.task_events.chrome_trace())
+            from ray_tpu.util import tracing
+
+            self._json(
+                runtime.task_events.chrome_trace()
+                + tracing.chrome_spans(runtime)
+            )
         elif path == "/api/traces":
             from ray_tpu.util import tracing
 
@@ -284,6 +320,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(
                 _llm_engines_snapshot(
                     runtime, steps_limit=int(q.get("steps", 32))
+                )
+            )
+        elif path == "/api/train":
+            from ray_tpu.train.observability import list_runs
+
+            self._json(
+                list_runs(
+                    limit=int(q.get("limit", 8)),
+                    rounds_limit=int(q.get("rounds", 8)),
                 )
             )
         elif path == "/metrics":

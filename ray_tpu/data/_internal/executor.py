@@ -159,6 +159,22 @@ def _tracked(
     s["wall_s"] = time.perf_counter() - t0
 
 
+def dominant_stage(stats: dict) -> Optional[Tuple[str, float]]:
+    """(stage name, seconds) of the stage with the largest measured
+    execution time — task execution wall when the stage reported it, the
+    streaming wall clock otherwise. This is what the train profiler blames
+    a worker's `data_wait` phase on."""
+    best: Optional[Tuple[str, float]] = None
+    for stage, s in list(stats.items()):
+        try:
+            seconds = sum(s.get("task_wall_s") or ()) or s.get("wall_s", 0.0)
+        except Exception:
+            continue
+        if seconds and (best is None or seconds > best[1]):
+            best = (stage, seconds)
+    return best
+
+
 def _iter_map_stage(
     upstream: Iterator[RefBundle],
     ops: List[Any],
@@ -560,7 +576,9 @@ def execute_streaming(
             )
             i = j
         elif isinstance(op, Limit):
-            stream = _iter_limit_stage(stream, op.limit)
+            stream = _tracked(
+                _iter_limit_stage(stream, op.limit), stats, _stage_key("Limit")
+            )
             i += 1
         elif isinstance(op, Repartition):
             bundles = _materialize(stream)

@@ -421,9 +421,12 @@ class Dataset:
 
     def stats(self) -> str:
         """Per-operator execution breakdown (reference data/_internal/stats.py
-        — the main input-pipeline perf tool; populated during execution):
-        blocks/rows/bytes produced, task wall-time distribution, and the
-        stage's streaming wall clock."""
+        — the main input-pipeline perf tool; populated during execution,
+        including consumption through iter_batches/streaming_split):
+        blocks/rows/bytes produced, task wall-time distribution, per-stage
+        throughput, and the stage's streaming wall clock."""
+        from ray_tpu.data._internal.executor import dominant_stage
+
         lines = [f"Dataset plan: {self._plan.describe()}"]
         for idx, (stage, s) in enumerate(self._stats.items(), 1):
             blocks = s.get("blocks", 0)
@@ -432,7 +435,8 @@ class Dataset:
                 f"Stage {idx} {stage}: {blocks} blocks produced in {wall:.2f}s"
             )
             if s.get("rows"):
-                lines.append(f"* Output rows: {s['rows']} total")
+                rate = f" ({s['rows'] / wall:.0f} rows/s)" if wall > 0 else ""
+                lines.append(f"* Output rows: {s['rows']} total{rate}")
             if s.get("bytes"):
                 lines.append(f"* Output size bytes: {s['bytes']} total")
             walls = s.get("task_wall_s") or []
@@ -444,7 +448,17 @@ class Dataset:
                     f"{max(walls)*1e3:.1f}ms max, "
                     f"{sum(walls)*1e3:.1f}ms total"
                 )
+        slowest = dominant_stage(self._stats)
+        if slowest is not None:
+            lines.append(
+                f"Slowest stage: {slowest[0]} ({slowest[1]*1e3:.1f}ms execution)"
+            )
         return "\n".join(lines)
+
+    def stats_dict(self) -> Dict[str, dict]:
+        """The raw per-stage counters behind stats() (latest execution) —
+        what the train profiler reads to blame data_wait on an operator."""
+        return {stage: dict(s) for stage, s in self._stats.items()}
 
     def __repr__(self):
         return f"Dataset(plan={self._plan.describe()})"

@@ -19,7 +19,13 @@ from typing import Optional
 def _init(args) -> None:
     import ray_tpu
 
-    ray_tpu.init(num_cpus=getattr(args, "num_cpus", None) or 8)
+    # ignore_reinit_error: handlers are also driven in-process against an
+    # already-running runtime (tests, embedding scripts); standalone CLI
+    # invocations still bootstrap their own.
+    ray_tpu.init(
+        num_cpus=getattr(args, "num_cpus", None) or 8,
+        ignore_reinit_error=True,
+    )
 
 
 def cmd_status(args) -> int:
@@ -54,10 +60,36 @@ def cmd_list(args) -> int:
 
 
 def cmd_summary(args) -> int:
-    from ray_tpu.util.state import summarize_tasks
+    from ray_tpu.util.state import summarize_actors, summarize_tasks
 
     _init(args)
-    print(json.dumps(summarize_tasks(), indent=2))
+    print(
+        json.dumps(
+            {"tasks": summarize_tasks(), "actors": summarize_actors()},
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_train_stats(args) -> int:
+    """Training telemetry: recent fit() runs with per-phase breakdowns and
+    straggler flags. With --url, queries a running head's dashboard
+    /api/train (the persistent-cluster path); without, reads this
+    process's run registry (fresh CLI runtimes have none — useful mainly
+    from scripts that just ran a trainer in-process)."""
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + f"/api/train?rounds={args.rounds}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            runs = json.loads(resp.read().decode())
+    else:
+        # The run registry is process-local: no runtime needed to read it.
+        from ray_tpu.train.observability import list_runs
+
+        runs = list_runs(rounds_limit=args.rounds)
+    print(json.dumps(runs, indent=2, default=str))
     return 0
 
 
@@ -214,7 +246,15 @@ def main(argv: Optional[list] = None) -> int:
         choices=["tasks", "actors", "nodes", "objects", "placement-groups"],
     )
 
-    sub.add_parser("summary", help="task summary by name:state")
+    sub.add_parser("summary", help="task + actor summaries by name:state")
+
+    p_ts = sub.add_parser(
+        "train-stats", help="recent training runs: rounds, phases, stragglers"
+    )
+    p_ts.add_argument(
+        "--url", default=None, help="dashboard base URL of a running head"
+    )
+    p_ts.add_argument("--rounds", type=int, default=8)
 
     p_tl = sub.add_parser("timeline", help="export chrome trace")
     p_tl.add_argument("--output", default="timeline.json")
@@ -258,6 +298,7 @@ def main(argv: Optional[list] = None) -> int:
         "status": cmd_status,
         "list": cmd_list,
         "summary": cmd_summary,
+        "train-stats": cmd_train_stats,
         "timeline": cmd_timeline,
         "job": cmd_job,
         "metrics": cmd_metrics,

@@ -4,6 +4,7 @@ from ray_tpu.air.config import (
     FailureConfig,
     RunConfig,
     ScalingConfig,
+    TrainConfig,
 )
 from ray_tpu.air.result import Result
 from ray_tpu.air.session import (
@@ -31,6 +32,11 @@ from ray_tpu.train.jax_trainer import (
     prepare_step,
 )
 from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.observability import (
+    StepProfiler,
+    TrainRunRecord,
+    list_runs,
+)
 
 __all__ = [
     "Backend",
@@ -46,8 +52,12 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "StepProfiler",
+    "TrainConfig",
+    "TrainRunRecord",
     "TrainingWorkerError",
     "WorkerGroup",
+    "list_runs",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",

@@ -56,6 +56,7 @@ class BackendExecutor:
         config: dict,
         checkpoint: Optional[Checkpoint],
         dataset_shard_fn: Optional[Callable[[int, int], Optional[dict]]] = None,
+        observability: Optional[dict] = None,
     ) -> None:
         assert self.worker_group is not None
         self._backend.on_training_start(self.worker_group, self._backend_config)
@@ -67,7 +68,9 @@ class BackendExecutor:
                 else None
             )
             refs.append(
-                worker.start_training.remote(train_fn, config, checkpoint, shards)
+                worker.start_training.remote(
+                    train_fn, config, checkpoint, shards, observability
+                )
             )
         try:
             ray_tpu.get(refs, timeout=300.0)
@@ -91,6 +94,13 @@ class BackendExecutor:
                 "Workers finished unevenly — mismatched session.report calls"
             )
         return results
+
+    def profile_records(self) -> list:
+        """Per-rank profiler rings from the live worker group (empty when
+        no group is up or instrumentation is off)."""
+        if self.worker_group is None:
+            return []
+        return self.worker_group.profile_records()
 
     def restart(self) -> None:
         """Tear down and re-form the worker group (reference _restart :625).

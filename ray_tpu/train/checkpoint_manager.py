@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import time
 from typing import Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
@@ -18,8 +19,21 @@ class CheckpointManager:
         self._counter = itertools.count()
         self.latest: Optional[Checkpoint] = None
         self.latest_metrics: dict = {}
+        # Driver-side checkpoint-phase accounting for the train profiler:
+        # registration is cheap for dict checkpoints but can spill/copy for
+        # directory ones, and that time belongs to the round that paid it.
+        self.last_register_s: float = 0.0
+        self.register_time_s: float = 0.0
+        self.registrations: int = 0
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> None:
+        t0 = time.perf_counter()
+        self._register(checkpoint, metrics)
+        self.last_register_s = time.perf_counter() - t0
+        self.register_time_s += self.last_register_s
+        self.registrations += 1
+
+    def _register(self, checkpoint: Checkpoint, metrics: dict) -> None:
         self.latest = checkpoint
         self.latest_metrics = dict(metrics)
         attr = self._config.checkpoint_score_attribute

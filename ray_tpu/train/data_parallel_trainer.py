@@ -13,7 +13,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
-from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig, TrainConfig
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
@@ -31,6 +31,7 @@ class DataParallelTrainer:
         backend_config: Optional[BackendConfig] = None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
+        train_config: Optional[TrainConfig] = None,
         datasets: Optional[dict] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
     ):
@@ -39,10 +40,25 @@ class DataParallelTrainer:
         self._backend_config = backend_config or self._default_backend_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.train_config = train_config or TrainConfig()
         self._datasets = dict(datasets or {})
         self._resume_checkpoint = resume_from_checkpoint
         self._latest_checkpoint: Optional[Checkpoint] = None
         self._result_callbacks: list[Callable[[dict], None]] = []
+        # Display name for the run registry (Tune sets it to the trial id).
+        self._run_name: Optional[str] = None
+        # Live executor while fit() runs — the mid-fit liveness surface.
+        self._executor: Optional[BackendExecutor] = None
+
+    def profile_records(self) -> list:
+        """Per-rank profiler rings straight from the live worker group —
+        mid-fit liveness (e.g. from a result callback or another thread,
+        without waiting for Result.train_report). [] before fit(), after
+        shutdown, or when instrumentation is off."""
+        executor = self._executor
+        if executor is None:
+            return []
+        return executor.profile_records()
 
     def add_result_callback(self, fn: Callable[[dict], None]) -> None:
         """Called with rank-0 metrics after every report round (Tune hook)."""
@@ -76,6 +92,10 @@ class DataParallelTrainer:
             if restored is not None:
                 trainer._resume_checkpoint = restored
             trainer._result_callbacks = list(base._result_callbacks)
+            # Trial rounds reuse the train run records: name the run after
+            # the trial so the registry/dashboard map trial -> telemetry.
+            ctx = session.get_context()
+            trainer._run_name = ctx.trial_name or ctx.trial_id or None
             # Forward each result round — with the workers' latest checkpoint,
             # so Tune-side save()/restore() (PBT, retries) is meaningful.
             trainer.add_result_callback(
@@ -121,10 +141,25 @@ class DataParallelTrainer:
         max_failures = failure_config.max_failures
         ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
         executor = BackendExecutor(self._backend_config, self.scaling_config)
+        self._executor = executor
         history: list[dict] = []
         error: Optional[BaseException] = None
         failures = 0
         start = time.monotonic()
+        run = None
+        if self.train_config.instrument:
+            from ray_tpu.train.observability import TrainRunRecord, register_run
+
+            run = register_run(
+                TrainRunRecord(
+                    name=self._run_name or self.run_config.name or type(self).__name__,
+                    trainer=type(self).__name__,
+                    num_workers=self.scaling_config.num_workers,
+                    straggler_factor=self.train_config.straggler_factor,
+                    straggler_min_s=self.train_config.straggler_min_s,
+                    rounds_capacity=self.train_config.rounds_capacity,
+                )
+            )
 
         try:
             while True:
@@ -138,7 +173,7 @@ class DataParallelTrainer:
                     # Fresh split coordinators per attempt: after a worker
                     # failure the old iterators are mid-stream/exhausted.
                     self._split_cache = {}
-                    self._run_training(executor, ckpt_manager, history)
+                    self._run_training(executor, ckpt_manager, history, run)
                     break
                 except TrainingWorkerError as exc:
                     failures += 1
@@ -147,8 +182,18 @@ class DataParallelTrainer:
                         break
                     # Resume the next attempt from the latest checkpoint.
                     self._resume_checkpoint = ckpt_manager.latest or self._resume_checkpoint
+        except BaseException as exc:
+            # Anything outside the worker-retry path (group-form timeout,
+            # KeyboardInterrupt, ...) propagates — but the run record must
+            # not report a crashed fit as ok.
+            error = exc
+            raise
         finally:
             executor.shutdown()
+            if run is not None:
+                # Closes the `train.fit` root span every round span chains
+                # to — one fit(), one connected trace.
+                run.finish(error)
 
         metrics = dict(ckpt_manager.latest_metrics or (history[-1] if history else {}))
         metrics.setdefault("time_total_s", time.monotonic() - start)
@@ -159,6 +204,7 @@ class DataParallelTrainer:
             error=error,
             path=self.run_config.resolved_storage_path(),
             metrics_history=history,
+            train_report=run.report() if run is not None else None,
         )
 
     def _run_training(
@@ -166,17 +212,31 @@ class DataParallelTrainer:
         executor: BackendExecutor,
         ckpt_manager: CheckpointManager,
         history: list[dict],
+        run=None,
     ) -> None:
+        observability = None
+        if run is not None:
+            observability = {
+                "trace": (run.trace_id, run.fit_span_id),
+                # Continue the driver's round numbering across failure
+                # restarts so retried rounds reuse their span ids (a retry
+                # is the same logical round re-executed).
+                "round_offset": len(history),
+                "capacity": self.train_config.profiler_capacity,
+            }
         executor.start_training(
             self._train_fn,
             self._train_config,
             self._resume_checkpoint,
             self._dataset_shard_fn,
+            observability=observability,
         )
         while True:
+            round_start = time.time()
             results = executor.next_results()
             if results is None:
                 return
+            profiles = [r.pop("profile", None) for r in results]
             rank0 = results[0]
             metrics = rank0["metrics"]
             # Rank 0's checkpoint is authoritative (reference: master-rank
@@ -187,6 +247,17 @@ class DataParallelTrainer:
                 self._latest_checkpoint = checkpoint
             else:
                 ckpt_manager.latest_metrics = dict(metrics)
+            round_idx = len(history)
             history.append(dict(metrics))
+            if run is not None:
+                run.record_round(
+                    round_idx,
+                    profiles,
+                    round_start,
+                    time.time(),
+                    checkpoint_s=(
+                        ckpt_manager.last_register_s if checkpoint is not None else 0.0
+                    ),
+                )
             for callback in self._result_callbacks:
                 callback(dict(metrics))

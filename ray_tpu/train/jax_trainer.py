@@ -47,22 +47,57 @@ def prepare_params(params: Any, rules: Optional[dict] = None) -> Any:
 
 
 def prepare_batch(batch: Any) -> Any:
-    """Shard a batch pytree over the mesh's data axes."""
+    """Shard a batch pytree over the mesh's data axes. Under an
+    instrumented session the host→device put counts as `data_wait` (it is
+    the step's wait-for-input tail), and batches feed the samples/sec
+    clock unless a profiled dataset iterator is already counting them."""
     import jax
 
     from ray_tpu.parallel import batch_sharding
+    from ray_tpu.train.observability import batch_rows, current_profiler
 
     mesh = session.get_mesh()
     sharding = batch_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+    profiler = current_profiler()
+    if profiler is None:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch
+        )
+    with profiler.phase("data_wait"):
+        out = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch
+        )
+    if not profiler.has_data_sources():
+        profiler.add_samples(batch_rows(batch))
+    return out
 
 
 def prepare_step(step_fn: Callable, donate_argnums=(0,)) -> Callable:
     """jit the train step; shardings propagate from the (already-sharded)
-    inputs, XLA inserts the gradient collectives."""
+    inputs, XLA inserts the gradient collectives. Under an instrumented
+    session each call is timed into the `compute` phase and bounded by
+    block_until_ready — otherwise async dispatch would bill device time to
+    whatever host code touches the result next."""
     import jax
 
-    return jax.jit(step_fn, donate_argnums=donate_argnums)
+    from ray_tpu.train.observability import current_profiler
+
+    jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+    # The session's profiler is fixed for the loop's lifetime, so decide
+    # once at prepare time: uninstrumented (or driver-side) callers get the
+    # jit callable itself — full jit API (.lower, .clear_cache), zero
+    # per-call overhead.
+    profiler = current_profiler()
+    if profiler is None:
+        return jitted
+
+    def instrumented_step(*args, **kwargs):
+        with profiler.phase("compute"):
+            out = jitted(*args, **kwargs)
+            jax.block_until_ready(out)
+        return out
+
+    return instrumented_step
 
 
 def report_from_rank0(metrics: dict, checkpoint=None) -> None:

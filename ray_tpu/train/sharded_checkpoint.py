@@ -15,14 +15,24 @@ import os
 from typing import Any, Optional
 
 
+def _checkpoint_phase():
+    """Train-profiler hook: inside an instrumented training session, time
+    spent writing/reading sharded checkpoints is the round's `checkpoint`
+    phase; everywhere else this is a no-op."""
+    from ray_tpu.train.observability import phase_or_null
+
+    return phase_or_null("checkpoint")
+
+
 def save_sharded(path: str, state: Any, *, force: bool = True) -> str:
     """Write a pytree of (possibly sharded, device-resident) arrays."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state, force=force)
-    ckptr.wait_until_finished()
+    with _checkpoint_phase():
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, state, force=force)
+        ckptr.wait_until_finished()
     return path
 
 
@@ -46,7 +56,8 @@ def restore_sharded(
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     if target is None:
-        return ckptr.restore(path)
+        with _checkpoint_phase():
+            return ckptr.restore(path)
     def _abstract(x):
         if not hasattr(x, "shape"):  # python scalars in optimizer state
             import jax.numpy as jnp
@@ -61,7 +72,8 @@ def restore_sharded(
             abstract,
             shardings,
         )
-    return ckptr.restore(path, abstract)
+    with _checkpoint_phase():
+        return ckptr.restore(path, abstract)
 
 
 def save_train_state(

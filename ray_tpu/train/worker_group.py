@@ -30,6 +30,7 @@ class RayTrainWorker:
         self.session: Optional[_Session] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[dict] = None
+        self.profiler = None  # StepProfiler while instrumented training runs
 
     # -- backend hooks -------------------------------------------------------
 
@@ -53,8 +54,23 @@ class RayTrainWorker:
         config: dict,
         checkpoint,
         dataset_shards: Optional[dict] = None,
+        observability: Optional[dict] = None,
     ) -> None:
-        session = _Session(self.context, checkpoint, dataset_shards)
+        profiler = None
+        if observability is not None:
+            from ray_tpu.train.observability import StepProfiler
+
+            profiler = StepProfiler(
+                rank=self.context.world_rank,
+                world_size=self.context.world_size,
+                trace=observability.get("trace"),
+                round_offset=observability.get("round_offset", 0),
+                capacity=observability.get("capacity", 512),
+            )
+        self.profiler = profiler
+        session = _Session(
+            self.context, checkpoint, dataset_shards, profiler=profiler
+        )
         self.session = session
         self._error = None
 
@@ -110,6 +126,13 @@ class RayTrainWorker:
     def shutdown_check(self) -> bool:
         return self._thread is None or not self._thread.is_alive()
 
+    def profile_records(self) -> list:
+        """This worker's bounded ring of per-round phase records
+        (train/observability.StepProfiler); [] when not instrumented."""
+        if self.profiler is None:
+            return []
+        return list(self.profiler.records)
+
 
 class WorkerGroup:
     """Creates/destroys the actor set + its placement group."""
@@ -160,6 +183,12 @@ class WorkerGroup:
     def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
         return ray_tpu.get(
             self.workers[rank].run_fn.remote(fn, *args, **kwargs), timeout=300.0
+        )
+
+    def profile_records(self) -> list[list]:
+        """Per-rank round-record rings (rank-indexed)."""
+        return ray_tpu.get(
+            [w.profile_records.remote() for w in self.workers], timeout=60.0
         )
 
     @property

@@ -396,6 +396,22 @@ class TuneController:
         self._save_experiment_state()
         return self.trials
 
+    def train_run_reports(self, rounds_limit: int = 8) -> Dict[str, list]:
+        """Per-trial training telemetry. Trainer-backed trials
+        (DataParallelTrainer.as_trainable) register their fit's round
+        records under the trial id, so trial rounds reuse the SAME records
+        the train profiler produced — one telemetry plane for standalone
+        fits and tuned ones. Trials may fit more than once (failure
+        retries, PBT exploits), hence a list per trial."""
+        from ray_tpu.train.observability import list_runs
+
+        trial_ids = {t.trial_id for t in self.trials}
+        out: Dict[str, list] = {}
+        for run in list_runs(limit=len(trial_ids) * 4 + 8, rounds_limit=rounds_limit):
+            if run["name"] in trial_ids:
+                out.setdefault(run["name"], []).append(run)
+        return out
+
     # -- experiment state ------------------------------------------------
 
     def _save_experiment_state(self) -> None:

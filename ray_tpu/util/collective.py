@@ -161,10 +161,16 @@ def _state(group_name: str) -> _GroupState:
 def _run(kind: str, payload, op: str, group_name: str, timeout: float):
     state = _state(group_name)
     seq = state.next_seq()
-    return ray_tpu.get(
-        state.handle.collect.remote(kind, seq, state.rank, payload, op),
-        timeout=timeout,
-    )
+    # Train-profiler hook: inside an instrumented training session the
+    # whole rendezvous (serialize + wait for the slowest rank) is the
+    # `collective` phase of the current report round.
+    from ray_tpu.train.observability import phase_or_null
+
+    with phase_or_null("collective"):
+        return ray_tpu.get(
+            state.handle.collect.remote(kind, seq, state.rank, payload, op),
+            timeout=timeout,
+        )
 
 
 def allreduce(array, op: str = "sum", group_name: str = "default", timeout: float = 60.0):

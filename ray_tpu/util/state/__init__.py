@@ -5,6 +5,7 @@ from ray_tpu.util.state.api import (
     list_objects,
     list_placement_groups,
     list_tasks,
+    summarize_actors,
     summarize_tasks,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "list_objects",
     "list_placement_groups",
     "list_tasks",
+    "summarize_actors",
     "summarize_tasks",
 ]

@@ -121,6 +121,16 @@ def summarize_tasks() -> Dict[str, int]:
     return out
 
 
+def summarize_actors() -> Dict[str, int]:
+    """State counts by actor class+state (reference: `ray summary actors`,
+    the summarize_tasks mirror over the actor table)."""
+    out: Dict[str, int] = {}
+    for row in list_actors(limit=100_000):
+        key = f"{row['class_name']}:{row['state']}"
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
 def _apply_filters(rows: List[dict], filters: Optional[list]) -> List[dict]:
     """filters = [(key, "=", value) | (key, "!=", value), ...]"""
     if not filters:

@@ -21,11 +21,29 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
+import random as _random
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+# Span/trace ids need uniqueness, not unpredictability; uuid4 reads
+# /dev/urandom per call (tens of µs on some kernels), which is too slow for
+# per-round/per-request emission paths. One urandom seed, then PRNG draws.
+# Re-seeded after fork (same hazard as _private/ids.py): a forked child
+# inheriting the parent's PRNG state would mint the parent's exact id stream.
+_ID_RNG = _random.Random(uuid.uuid4().int)
+_ID_LOCK = threading.Lock()
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=lambda: _ID_RNG.seed(uuid.uuid4().int))
+
+
+def _fast_id() -> str:
+    with _ID_LOCK:
+        return f"{_ID_RNG.getrandbits(64):016x}"
 
 _ambient: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_trace", default=None
@@ -158,10 +176,10 @@ def span(name: str, attributes: Optional[dict] = None):
     if parent is not None:
         trace_id, parent_id = parent.trace_id, parent.span_id
     else:
-        trace_id, parent_id = uuid.uuid4().hex[:16], None
+        trace_id, parent_id = _fast_id(), None
     record = Span(
         trace_id=trace_id,
-        span_id=uuid.uuid4().hex[:16],
+        span_id=_fast_id(),
         parent_span_id=parent_id,
         name=name,
         start_s=time.time(),
@@ -178,7 +196,7 @@ def span(name: str, attributes: Optional[dict] = None):
 
 
 def new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return _fast_id()
 
 
 def emit_span(
@@ -208,8 +226,8 @@ def emit_span(
         if parent_span_id is None:
             parent_span_id = parent[1]
     record = Span(
-        trace_id=trace_id or uuid.uuid4().hex[:16],
-        span_id=span_id or new_span_id(),
+        trace_id=trace_id or _fast_id(),
+        span_id=span_id or _fast_id(),
         parent_span_id=parent_span_id,
         name=name,
         start_s=start_s,
@@ -224,6 +242,36 @@ def emit_span(
 def local_spans() -> List[dict]:
     """Finished user spans recorded in THIS process."""
     return [s.to_dict() for s in _buffer.snapshot()]
+
+
+def chrome_spans(runtime=None) -> List[dict]:
+    """Buffered tracing spans as chrome-trace events, one pid row group per
+    trace so serving (`llm.*`) and training (`train.*`) spans land on the
+    same timeline as the task events (`ray_tpu.timeline()` merges both).
+    Task-kind spans are excluded — the task-event buffer already renders
+    those rows; duplicating them would double every task."""
+    rows: List[dict] = []
+    for s in traces(runtime=runtime):
+        if s.get("kind") != "user" or s.get("end_s") is None:
+            continue
+        rows.append(
+            {
+                "cat": "span",
+                "name": s["name"],
+                "ph": "X",
+                "ts": s["start_s"] * 1e6,
+                "dur": max(0.0, s["end_s"] - s["start_s"]) * 1e6,
+                "pid": f"trace:{s['trace_id'][:8]}",
+                "tid": s["name"],
+                "args": {
+                    "span_id": s["span_id"],
+                    "parent_span_id": s["parent_span_id"],
+                    "trace_id": s["trace_id"],
+                    **(s.get("attributes") or {}),
+                },
+            }
+        )
+    return rows
 
 
 def traces(trace_id: Optional[str] = None, runtime=None) -> List[dict]:

@@ -374,6 +374,42 @@ def test_streaming_split_many_blocks_shared_coordinator(ray_start_regular):
     assert sorted(seen[0] + seen[1]) == list(range(200))
 
 
+def test_stats_every_operator_after_iter_batches(ray_start_regular):
+    """A map->filter->batch pipeline reports every operator with nonzero
+    rows and wall time, and the stats populate through iter_batches
+    consumption (the train-ingest path), not just materialization."""
+    ds = (
+        rd.range(64, parallelism=4)
+        .map(lambda row: {"id": row["id"]})
+        .filter(lambda row: row["id"] % 2 == 0)
+        .map_batches(lambda b: {"id": b["id"] * 2}, batch_size=8)
+    )
+    batches = list(ds.iter_batches(batch_size=8, drop_last=False))
+    assert sum(len(b["id"]) for b in batches) == 32
+
+    stats = ds.stats_dict()
+    report = ds.stats()
+    for op in ("Map", "Filter", "MapBatches"):
+        assert op in report, report
+        stage = next(s for name, s in stats.items() if op in name)
+        assert stage["rows"] > 0
+        assert stage["wall_s"] > 0
+        assert stage["task_wall_s"] and all(w > 0 for w in stage["task_wall_s"])
+    assert "Slowest stage:" in report
+
+    # Limit stages are tracked too (previously dark).
+    limited = rd.range(64, parallelism=4).map(lambda r: r).limit(10)
+    assert limited.count() == 10
+    assert any("Limit" in name for name in limited.stats_dict())
+
+    # Re-consumption re-runs the plan; stats reflect the latest epoch, not
+    # an accumulation across epochs.
+    list(ds.iter_batches(batch_size=8))
+    stats2 = ds.stats_dict()
+    stage2 = next(s for name, s in stats2.items() if "Filter" in name)
+    assert stage2["rows"] == 32
+
+
 def test_stats_per_operator_breakdown(ray_start_regular):
     """ds.stats() reports blocks/rows/bytes and task wall-time distribution
     per operator (the reference's main input-pipeline perf tool)."""

@@ -17,6 +17,7 @@ from ray_tpu.util.state import (
     list_objects,
     list_placement_groups,
     list_tasks,
+    summarize_actors,
     summarize_tasks,
 )
 
@@ -92,6 +93,76 @@ def test_summarize_and_timeline(ray_start_regular, tmp_path):
     step_events = [e for e in events if e["name"].split(".")[-1] == "step"]
     assert len(step_events) == 3
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in step_events)
+
+
+def test_summarize_actors(ray_start_regular, capsys):
+    """summarize_actors: class:state counts (the summarize_tasks mirror),
+    wired into the CLI `summary` output next to the task summary."""
+
+    @ray_tpu.remote
+    class Widget:
+        def ping(self):
+            return 1
+
+    actors = [Widget.remote() for _ in range(3)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    summary = summarize_actors()
+    assert summary.get("Widget:ALIVE") == 3, summary
+    ray_tpu.kill(actors[0])
+    time.sleep(0.1)
+    summary = summarize_actors()
+    assert summary.get("Widget:ALIVE") == 2, summary
+    assert summary.get("Widget:DEAD") == 1, summary
+
+    # The CLI summary serves both tables (driven in-process against the
+    # running runtime — cli._init tolerates the live fixture runtime).
+    import json as _json
+
+    from ray_tpu.scripts.cli import cmd_summary
+
+    class _Args:
+        num_cpus = None
+
+    assert cmd_summary(_Args()) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert "tasks" in out and "actors" in out
+    assert out["actors"].get("Widget:ALIVE") == 2
+
+
+def test_timeline_merges_tracing_spans(ray_start_regular, tmp_path):
+    """ray_tpu.timeline() carries buffered tracing spans as their own pid
+    rows next to the task events, with valid chrome-trace fields."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    with tracing.span("outer", {"k": "v"}):
+        ray_tpu.get(work.remote())
+    tracing.emit_span("loose.phase", time.time() - 0.01, time.time())
+
+    out = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(out))
+    span_rows = [e for e in events if e["cat"] == "span"]
+    names = {e["name"] for e in span_rows}
+    assert {"outer", "loose.phase"} <= names
+    task_rows = [e for e in events if e["cat"] == "task"]
+    assert task_rows  # both sources on one timeline
+    for row in span_rows:
+        assert row["ph"] == "X"
+        assert isinstance(row["ts"], float) and row["ts"] > 0
+        assert isinstance(row["dur"], float) and row["dur"] >= 0
+        assert row["pid"].startswith("trace:")
+        assert row["tid"] == row["name"]
+        assert row["args"]["span_id"]
+    outer = next(e for e in span_rows if e["name"] == "outer")
+    assert outer["args"]["k"] == "v"
+    # The file round-trips as JSON (chrome://tracing loadable).
+    import json as _json
+
+    with open(out) as f:
+        assert len(_json.load(f)) == len(events)
 
 
 def test_actor_task_events(ray_start_regular):

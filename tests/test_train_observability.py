@@ -1,0 +1,358 @@
+"""Training-path observability: per-worker step profiler, straggler
+detection, connected train traces, dashboard/CLI surfacing.
+
+Acceptance slice: one JaxTrainer.fit() with >= 2 workers and >= 2 report
+rounds yields ONE connected trace (train.fit root -> train.round ->
+per-rank train.worker.round), per-phase histograms whose counts equal
+rounds x ranks, and a straggler report that flags an artificially-delayed
+rank with the correct dominant phase via the fault-injection hook.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu import train
+from ray_tpu.air import Checkpoint
+from ray_tpu.train import JaxTrainer, ScalingConfig, TrainConfig
+from ray_tpu.train import observability as tobs
+from ray_tpu._private import fault_injection as fi
+from ray_tpu.util import metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    tobs.reset_runs()
+    yield
+    tobs.reset_runs()
+    fi.clear()
+
+
+def _fit(loop, num_workers=2, **kwargs):
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=num_workers, cpus_per_worker=1),
+        **kwargs,
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    return result
+
+
+# ---------------- the acceptance trace + histograms ----------------
+
+
+def test_connected_trace_and_phase_histograms(ray_start_regular):
+    """2 workers x 3 rounds: one connected trace, histogram counts equal
+    rounds x ranks for every phase, and the run lands in the registry."""
+
+    def loop(config):
+        from ray_tpu.util import collective
+
+        for i in range(3):
+            # Touch the collective hook so the phase is nonzero somewhere.
+            collective.barrier(group_name="train")
+            train.report({"i": i})
+
+    result = _fit(loop)
+    rep = result.train_report
+    assert rep is not None
+    assert rep["rounds_total"] == 3
+    assert rep["num_workers"] == 2
+    assert set(rep["phase_stats"]) == set(tobs.TRAIN_PHASES)
+    # Collective rendezvous really was timed on some rank-round.
+    assert rep["phase_stats"]["collective"]["max"] > 0
+
+    spans = [s for s in tracing.local_spans() if s["trace_id"] == rep["trace_id"]]
+    roots = [s for s in spans if s["name"] == "train.fit"]
+    assert len(roots) == 1 and roots[0]["parent_span_id"] is None
+    assert len([s for s in spans if s["name"] == "train.round"]) == 3
+    worker_rounds = [s for s in spans if s["name"] == "train.worker.round"]
+    assert len(worker_rounds) == 6
+    assert {s["attributes"]["rank"] for s in worker_rounds} == {0, 1}
+    # Connectivity: every span chains up to the single train.fit root.
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        cur = s
+        hops = 0
+        while cur["parent_span_id"] is not None:
+            cur = by_id[cur["parent_span_id"]]
+            hops += 1
+            assert hops < 10
+        assert cur["span_id"] == roots[0]["span_id"]
+
+    # Per-phase histogram counts = rounds x ranks, exactly.
+    h = metrics.get_or_create(metrics.Histogram, "train_round_seconds")
+    series = h._series()
+    for phase in tobs.TRAIN_PHASES:
+        key = (("phase", phase),)
+        assert series[key]["count"] == 6, (phase, series)
+    h_report = metrics.get_or_create(
+        metrics.Histogram, "train_report_round_seconds"
+    )
+    assert sum(s["count"] for s in h_report._series().values()) == 3
+
+    # The run registry serves the same snapshot the Result carries.
+    runs = tobs.list_runs()
+    assert any(r["run_id"] == rep["run_id"] for r in runs)
+
+
+def test_compute_and_checkpoint_phases_measured(ray_start_regular):
+    """The flagship sharded-regression loop attributes nonzero compute
+    (prepare_step, block_until_ready-bounded) and records samples via
+    prepare_batch; checkpoints flow through the checkpoint phase hook."""
+    import jax
+    import jax.numpy as jnp
+
+    def loop(config):
+        x = jnp.ones((32, 8))
+        y = jnp.ones((32,))
+        params = train.prepare_params({"w": jnp.zeros(8)})
+        batch = train.prepare_batch({"x": x, "y": y})
+
+        def step(params, batch):
+            def loss_fn(p):
+                return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+            grads = jax.grad(loss_fn)(params)
+            return {"w": params["w"] - 0.1 * grads["w"]}
+
+        jit_step = train.prepare_step(step, donate_argnums=())
+        for epoch in range(2):
+            params = jit_step(params, batch)
+            ckpt = Checkpoint.from_dict({"w": np.asarray(params["w"])})
+            train.report({"epoch": epoch}, checkpoint=ckpt)
+
+    result = _fit(loop, num_workers=1)
+    rep = result.train_report
+    rank_rounds = [r for row in rep["rounds"] for r in row["ranks"]]
+    assert any(r["phases"]["compute"] > 0 for r in rank_rounds)
+    # prepare_batch counted the 32-row batch in the round that sharded it.
+    assert rep["samples_total"] == 32
+    # The checkpoint phase clock ran (Checkpoint.from_dict hook) — dict
+    # checkpoints are cheap, so assert presence in the stats, not size.
+    assert "checkpoint" in rep["phase_stats"]
+
+
+# ---------------- straggler detection (fault-injection hook) ----------------
+
+
+def test_straggler_flagged_with_dominant_phase(ray_start_regular):
+    """An artificially-delayed rank (fault injection at the train.data_wait
+    site) is flagged as a straggler with data_wait as the dominant phase,
+    and data_wait is blamed on the dataset's dominant operator."""
+    ds = rd.range(16, parallelism=2)
+
+    def loop(config):
+        shard = train.get_dataset_shard("train")
+        for r in range(3):
+            for _batch in shard.iter_batches(batch_size=4, prefetch_batches=0):
+                pass
+            train.report({"r": r})
+
+    fi.inject(
+        "train.data_wait", match="rank=1", action="delay",
+        delay_s=0.3, times=None, every=1,
+    )
+    result = _fit(
+        loop,
+        datasets={"train": ds},
+        train_config=TrainConfig(straggler_factor=2.0, straggler_min_s=0.05),
+    )
+    fi.clear()
+    rep = result.train_report
+    assert rep["straggler_rounds"] >= 1
+    flagged = [s for s in rep["stragglers"] if s["rank"] == 1]
+    assert flagged, rep["stragglers"]
+    assert all(s["phase"] == "data_wait" for s in flagged)
+    # No false positives on the healthy rank.
+    assert not any(s["rank"] == 0 for s in rep["stragglers"])
+    # data_wait blamed on the pipeline's dominant operator.
+    assert any(s.get("data_blame") for s in flagged)
+    # The straggler counter carries the dominant phase tag.
+    c = metrics.get_or_create(metrics.Counter, "train_straggler_rounds")
+    assert c._series().get((("phase", "data_wait"),), 0) >= 1
+
+
+def test_slow_rank_flagged_fast_rank_is_not(ray_start_regular):
+    """Rendezvous waits must not produce false positives: the slow rank is
+    flagged, and since its delay is unhooked user time (a bare sleep, no
+    phase clock running) the dominant phase is reported as `untracked` —
+    never some near-zero phase. The fast rank is never flagged."""
+
+    def loop(config):
+        import time as _t
+
+        rank = train.get_world_rank()
+        for i in range(2):
+            if rank == 0:
+                _t.sleep(0.25)
+            train.report({"i": i})
+
+    result = _fit(loop, train_config=TrainConfig(straggler_min_s=0.05))
+    rep = result.train_report
+    assert not any(s["rank"] == 1 for s in rep["stragglers"])
+    flagged = [s for s in rep["stragglers"] if s["rank"] == 0]
+    assert flagged and all(s["phase"] == "untracked" for s in flagged)
+
+
+# ---------------- instrument knob ----------------
+
+
+def test_instrument_off_compiles_plane_out(ray_start_regular):
+    def loop(config):
+        for i in range(2):
+            train.report({"i": i})
+
+    # The span buffer is process-global and append-only; assert on the
+    # spans THIS fit adds, not on leftovers from earlier tests.
+    before = len(tracing.local_spans())
+    result = _fit(loop, train_config=TrainConfig(instrument=False))
+    assert result.train_report is None
+    new_spans = tracing.local_spans()[before:]
+    assert not [s for s in new_spans if s["name"].startswith("train.")]
+    assert "train_round_seconds" not in metrics.prometheus_text()
+    assert tobs.list_runs() == []
+
+
+def test_train_metrics_reregister_lazily_after_reset(ray_start_regular):
+    """reset_registry() between tests must not orphan the train family: the
+    next instrumented fit re-registers it via get_or_create (the engine
+    metrics contract)."""
+
+    def loop(config):
+        train.report({"i": 0})
+
+    _fit(loop, num_workers=1)
+    assert "train_round_seconds" in metrics.prometheus_text()
+    metrics.reset_registry()
+    assert "train_round_seconds" not in metrics.prometheus_text()
+    _fit(loop, num_workers=1)
+    text = metrics.prometheus_text()
+    assert "train_round_seconds" in text
+    # Fresh counts after the reset: 1 round x 1 rank per phase.
+    h = metrics.get_or_create(metrics.Histogram, "train_round_seconds")
+    assert h._series()[(("phase", "compute"),)]["count"] == 1
+
+
+def test_profile_records_live_during_fit(ray_start_regular):
+    """The per-worker ring is readable mid-fit through the trainer →
+    BackendExecutor → WorkerGroup → RayTrainWorker chain (the liveness
+    surface: no waiting for Result.train_report)."""
+
+    def loop(config):
+        for i in range(3):
+            train.report({"i": i})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1)
+    )
+    assert trainer.profile_records() == []  # nothing up before fit()
+
+    live: list = []
+    trainer.add_result_callback(lambda m: live.append(trainer.profile_records()))
+    result = trainer.fit()
+    assert result.error is None, result.error
+
+    # The last mid-fit snapshot saw both ranks with >= 1 closed round each.
+    rings = live[-1]
+    assert len(rings) == 2
+    for rank, ring in enumerate(rings):
+        assert ring, f"rank {rank} ring empty mid-fit"
+        assert all(r["rank"] == rank for r in ring)
+        assert set(ring[0]["phases"]) == set(tobs.TRAIN_PHASES)
+
+
+def test_tune_trials_map_to_train_run_records(ray_start_regular):
+    """Trainer-backed Tune trials register their fit's telemetry under the
+    trial id: TuneController.train_run_reports() joins them back."""
+    from ray_tpu import tune
+
+    def loop(config):
+        for i in range(2):
+            train.report({"score": float(config.get("lr", 0.0)) + i})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1, chips_per_worker=0)
+    )
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([0.1, 0.2])}},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+
+    reports = tuner._controller.train_run_reports()
+    trial_ids = {t.trial_id for t in tuner._controller.trials}
+    assert set(reports) == trial_ids
+    for trial_id, runs in reports.items():
+        assert runs and runs[0]["rounds_total"] == 2, (trial_id, runs)
+
+
+# ---------------- profiler unit behavior ----------------
+
+
+def test_step_profiler_rounds_and_ring_bound():
+    prof = tobs.StepProfiler(rank=3, world_size=4, capacity=4)
+    for i in range(6):
+        with prof.phase("compute"):
+            pass
+        prof.add_samples(8)
+        record = prof.end_round()
+        assert record["round"] == i
+        assert record["rank"] == 3
+        assert record["samples"] == 8
+        assert record["phases"]["compute"] >= 0
+    assert len(prof.records) == 4  # bounded ring
+    assert [r["round"] for r in prof.records] == [2, 3, 4, 5]
+
+
+def test_round_span_ids_deterministic():
+    fit_sid = tracing.new_span_id()
+    assert tobs.round_span_id(fit_sid, 7) == tobs.round_span_id(fit_sid, 7)
+    assert tobs.round_span_id(fit_sid, 7) != tobs.round_span_id(fit_sid, 8)
+
+
+# ---------------- dashboard + CLI surfacing ----------------
+
+
+@pytest.fixture
+def dash_ray():
+    runtime = ray_tpu.init(
+        num_cpus=4,
+        _system_config={"include_dashboard": True, "dashboard_port": 0},
+    )
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_dashboard_train_panel_and_cli(dash_ray, capsys):
+    def loop(config):
+        for i in range(2):
+            train.report({"i": i})
+
+    _fit(loop)
+    base = dash_ray.dashboard.url
+    with urllib.request.urlopen(f"{base}/api/train?rounds=4", timeout=10) as resp:
+        rows = json.loads(resp.read().decode())
+    assert rows and rows[0]["rounds_total"] == 2
+    assert rows[0]["num_workers"] == 2
+    assert len(rows[0]["rounds"]) == 2
+    assert rows[0]["rounds"][0]["ranks"][0]["phases"].keys() == set(
+        tobs.TRAIN_PHASES
+    )
+    with urllib.request.urlopen(base, timeout=10) as resp:
+        assert "Train runs" in resp.read().decode()
+
+    # CLI train-stats against the running head's dashboard.
+    from ray_tpu.scripts import cli
+
+    assert cli.main(["train-stats", "--url", base, "--rounds", "2"]) == 0
+    out = capsys.readouterr().out
+    parsed = json.loads(out)
+    assert parsed[0]["rounds_total"] == 2
