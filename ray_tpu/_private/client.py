@@ -178,7 +178,11 @@ class ClientCore:
             event.set()
 
     def close(self) -> None:
-        self.closed = True
+        # Same lock _fail_all publishes under: an RPC thread checking
+        # `closed` must never see the flag flip between its check and its
+        # waiter registration (found by lint RTL201).
+        with self._rpc_lock:
+            self.closed = True
         self.conn.close()
 
 

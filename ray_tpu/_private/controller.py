@@ -46,14 +46,30 @@ class NodeState:
         self.available = dict(self.total)
 
     def feasible(self, request: dict[str, float]) -> bool:
-        return all(self.total.get(k, 0.0) + _EPS >= v for k, v in request.items())
+        # Locked: the scheduler thread scores nodes while PG commit /
+        # autoscaler threads mutate the resource vectors under the lock;
+        # an unlocked multi-key read could see a half-applied update and
+        # mis-place (found by lint RTL201).
+        with self._lock:
+            return all(
+                self.total.get(k, 0.0) + _EPS >= v
+                for k, v in request.items()
+            )
 
     def can_allocate(self, request: dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) + _EPS >= v for k, v in request.items())
+        with self._lock:
+            return self._can_allocate_locked(request)
+
+    def _can_allocate_locked(self, request: dict[str, float]) -> bool:
+        """Caller must hold self._lock (non-reentrant)."""
+        return all(
+            self.available.get(k, 0.0) + _EPS >= v
+            for k, v in request.items()
+        )
 
     def allocate(self, request: dict[str, float]) -> bool:
         with self._lock:
-            if not self.alive or not self.can_allocate(request):
+            if not self.alive or not self._can_allocate_locked(request):
                 return False
             for k, v in request.items():
                 self.available[k] = self.available.get(k, 0.0) - v
@@ -82,12 +98,13 @@ class NodeState:
         """Critical-resource utilization after hypothetically granting `request`
         (hybrid_scheduling_policy.h:29-50 scoring)."""
         score = 0.0
-        for k, v in request.items():
-            total = self.total.get(k, 0.0)
-            if total <= 0:
-                return 1.0
-            used = total - self.available.get(k, 0.0) + v
-            score = max(score, used / total)
+        with self._lock:
+            for k, v in request.items():
+                total = self.total.get(k, 0.0)
+                if total <= 0:
+                    return 1.0
+                used = total - self.available.get(k, 0.0) + v
+                score = max(score, used / total)
         return score
 
 
@@ -300,6 +317,9 @@ class Controller:
             ok = True
             for idx, node in placement.items():
                 bundle = record.bundles[idx]
+                # ray-tpu: lint-ignore[RTL404] allocate/release are
+                # bool-returning and non-raising; the ok-flag rollback
+                # below already covers the only failure mode
                 if node.allocate(bundle):
                     prepared.append((node, bundle))
                 else:
@@ -389,11 +409,21 @@ def _place_bundles(
     """
     if not nodes:
         return None
-    sim = {n.node_id: dict(n.available) for n in nodes}
+    # Snapshot under each node's lock: the task-scheduler thread mutates
+    # the resource vectors under it, and dict() over a resizing dict
+    # raises — same torn-read hazard as the locked NodeState readers.
+    sim: dict = {}
+    alive: dict = {}
+    for n in nodes:
+        with n._lock:
+            sim[n.node_id] = dict(n.available)
+            alive[n.node_id] = n.alive
 
     def fits(node: NodeState, bundle: dict[str, float]) -> bool:
         avail = sim[node.node_id]
-        return node.alive and all(avail.get(k, 0.0) + _EPS >= v for k, v in bundle.items())
+        return alive[node.node_id] and all(
+            avail.get(k, 0.0) + _EPS >= v for k, v in bundle.items()
+        )
 
     def take(node: NodeState, bundle: dict[str, float]) -> None:
         avail = sim[node.node_id]

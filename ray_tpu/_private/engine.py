@@ -500,6 +500,8 @@ class ActorExecutor:
         self._on_task_done(spec, self.node.node, {}, result)
 
     def _drain_inbox(self) -> None:
+        with self._lock:
+            reason = self.death_reason
         while True:
             try:
                 spec = self._inbox.get_nowait()
@@ -512,6 +514,6 @@ class ActorExecutor:
                 self.node.node,
                 {},
                 TaskResult(
-                    exc=ActorDiedError(self.actor_id, self.death_reason or "actor died")
+                    exc=ActorDiedError(self.actor_id, reason or "actor died")
                 ),
             )

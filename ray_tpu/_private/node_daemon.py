@@ -717,6 +717,9 @@ class NodeDaemon:
         gcs_redis_failure_detector.h)."""
         import time as _time
 
+        # ray-tpu: lint-ignore[RTL201] advisory fast-path read of an
+        # atomic bool; shutdown-vs-reconnect is settled by the locked
+        # state swaps below, a stale read here only wastes one attempt
         if self.reconnect_window_s <= 0 or self._closed:
             return False
         with self._lock:
@@ -816,6 +819,11 @@ class NodeDaemon:
             workers = list(self.workers.values())
             self.workers.clear()
         with self._loc_lock:
+            # Re-publish the flag under the loc lock too: the flusher
+            # thread reads _closed while holding only _loc_lock, so this
+            # is the barrier that makes the wake-up check reliable
+            # (found by lint RTL201).
+            self._closed = True
             loc_waiters = [
                 w for waiters in self._loc_waiters.values() for w in waiters
             ]

@@ -505,11 +505,14 @@ class Runtime:
             self.store.drop_node_locations(node_id)
         if engine is None:
             return
-        # Collect this node's actors before shutdown kills them.
-        doomed_actors = [
-            (aid, ex) for aid, ex in list(self.actor_executors.items())
-            if ex.node.node is node
-        ]
+        # Collect this node's actors before shutdown kills them. Snapshot
+        # under the lock: other threads add/remove executors under it, and
+        # items() over a resizing dict raises (found by lint RTL201).
+        with self._lock:
+            doomed_actors = [
+                (aid, ex) for aid, ex in self.actor_executors.items()
+                if ex.node.node is node
+            ]
         engine.shutdown()
         for actor_id, executor in doomed_actors:
             with self._lock:
@@ -911,11 +914,14 @@ class Runtime:
         self.controller.register_actor(record)
         self.refcount.add_owned_object(spec.return_ids[0], owner_task=spec.task_id)
         creation_ref = ObjectRef(spec.return_ids[0])
-        if detached:
-            # A detached actor's lifetime is the cluster's: pin its creation
-            # object so dropping the user handle can't collect it.
-            self._detached_creation_refs.append(creation_ref)
         with self._lock:
+            if detached:
+                # A detached actor's lifetime is the cluster's: pin its
+                # creation object so dropping the user handle can't collect
+                # it. Under the lock: _handle_actor_death prunes this list
+                # under self._lock from other threads (found by lint
+                # RTL201).
+                self._detached_creation_refs.append(creation_ref)
             self._actor_specs[actor_id] = spec
             self._actor_buffers[actor_id] = []
             self._task_records[spec.task_id] = _TaskRecord(spec, resources)

@@ -207,5 +207,11 @@ class RuntimeEnvManager:
                     ctx._added_paths = []
 
     def cleanup(self) -> None:
-        shutil.rmtree(self._root, ignore_errors=True)
-        self._cache.clear()
+        # Clear BEFORE removing the tree, both under the lock: a
+        # concurrent get_or_create must either see the cached env (and a
+        # live dir) or miss and rebuild from scratch, never a cache hit
+        # pointing at the tree rmtree just removed (found by lint
+        # RTL201).
+        with self._lock:
+            self._cache.clear()
+            shutil.rmtree(self._root, ignore_errors=True)

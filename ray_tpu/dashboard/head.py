@@ -199,14 +199,14 @@ def _llm_engines_snapshot(runtime, steps_limit: int = 32) -> list:
         except Exception as exc:
             row["error"] = repr(exc)
             pending.append((row, None))
-    deadline = time.time() + 2.0
+    deadline = time.monotonic() + 2.0
     rows = []
     for row, ref in pending:
         if ref is not None:
             try:
                 row.update(
                     ray_tpu.get(
-                        ref, timeout=max(deadline - time.time(), 0.05)
+                        ref, timeout=max(deadline - time.monotonic(), 0.05)
                     )
                 )
             except Exception as exc:

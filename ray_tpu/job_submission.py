@@ -78,7 +78,7 @@ class JobSupervisor:
                 if self._stopped:
                     _update(kv, self.job_id, status=STOPPED, end_time=time.time())
                     return STOPPED
-                self.proc = subprocess.Popen(
+                proc = self.proc = subprocess.Popen(
                     self.entrypoint,
                     shell=True,
                     stdout=logf,
@@ -87,8 +87,13 @@ class JobSupervisor:
                     cwd=cwd,
                     start_new_session=True,
                 )
-            returncode = self.proc.wait()
-        if self._stopped:
+            returncode = proc.wait()
+        # Under the lock: stop() publishes _stopped before killing the
+        # process group, so a wait() woken by that kill must classify as
+        # STOPPED, never FAILED-with-SIGTERM.
+        with self._lock:
+            stopped = self._stopped
+        if stopped:
             _update(kv, self.job_id, status=STOPPED, end_time=time.time())
             return STOPPED
         if returncode == 0:
@@ -223,8 +228,8 @@ class JobSubmissionClient:
     def wait_until_finish(
         self, job_id: str, timeout: float = 300.0, poll_s: float = 0.2
     ) -> str:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             status = self.get_job_status(job_id)
             if status in (SUCCEEDED, FAILED, STOPPED):
                 return status

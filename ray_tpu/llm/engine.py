@@ -362,7 +362,11 @@ class LLMEngine:
         self._current_rid = None
         maybe_fail("llm.step")
         instrument = self._instrument
+        # Wall clock for record identity ("time" field), perf_counter for
+        # the duration — wall time steps under NTP and would corrupt
+        # duration_s exactly when an operator is staring at the recorder.
         t_step = time.time() if instrument else 0.0
+        t_step_p = time.perf_counter() if instrument else 0.0
 
         admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
         prefill_info: List[dict] = []
@@ -382,7 +386,7 @@ class LLMEngine:
             raise
 
         decoding = self.scheduler.schedule_decode()
-        t_decode = time.time() if instrument else 0.0
+        t_decode = time.perf_counter() if instrument else 0.0
         if decoding:
             slots = ecfg.max_decode_slots
             nb = ecfg.max_blocks_per_seq
@@ -420,7 +424,8 @@ class LLMEngine:
                 # One observation per batched decode dispatch, never per
                 # token — the whole emission loop rides in it.
                 self._h_step.observe(
-                    time.time() - t_decode, tags=self._step_tags["decode"]
+                    time.perf_counter() - t_decode,
+                    tags=self._step_tags["decode"],
                 )
 
         self._steps += 1
@@ -469,7 +474,7 @@ class LLMEngine:
                     "cache_hit_tokens": step_hit_tokens,
                     "preempted": preempted,
                     "queue_depth": len(self.scheduler.waiting),
-                    "duration_s": round(time.time() - t_step, 6),
+                    "duration_s": round(time.perf_counter() - t_step_p, 6),
                     "time": t_step,
                 }
             )
@@ -509,11 +514,16 @@ class LLMEngine:
             was_cow = seq.pending_copy is not None
             if was_cow:
                 # Copy-on-write: the last matched block is shared and this
-                # prefill writes its final token's K/V into it.
+                # prefill writes its final token's K/V into it. pending_copy
+                # is cleared only AFTER the device copy lands and the
+                # copy-source ref is dropped: if copy_block raises (poison
+                # request, injected fault), _release must still see the
+                # marker and free src — clearing first leaked the ref and
+                # permanently shrank the block pool (found by lint RTL403).
                 src, dst = seq.pending_copy
-                seq.pending_copy = None
                 self.runner.copy_block(src, dst)
                 self.allocator.free([src])  # drop admission's copy-source ref
+                seq.pending_copy = None
             n_suffix = len(seq.prefill_ids) - offset
             if offset > 0:
                 first = self.runner.prefill_suffix(
@@ -531,6 +541,9 @@ class LLMEngine:
                 kind = "cow" if was_cow else ("partial" if offset else "full")
                 phase = "partial_prefill" if offset else "prefill"
                 bucket = self.engine_config.bucket_for(max(n_suffix, 1))
+                # ray-tpu: lint-ignore[RTL302] t0/t1 double as span
+                # timestamps (wall-clock identity across actors); the
+                # histogram delta rides on the same pair
                 self._h_step.observe(t1 - t0, tags=self._step_tags[phase])
                 self._h_queue.observe(queue_wait or 0.0, tags=self._metric_tags)
                 if rt is not None:
@@ -1019,6 +1032,9 @@ class LLMServer:
             )
 
     def check_health(self) -> bool:
+        # ray-tpu: lint-ignore[RTL201] atomic bool read; taking the engine
+        # lock here would park the health probe behind a full step (or a
+        # bucket compile) and make the controller churn healthy replicas
         return self._thread.is_alive() and not self._wedged
 
     def shutdown(self) -> None:

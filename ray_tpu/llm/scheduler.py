@@ -165,6 +165,9 @@ class Scheduler:
         if not self.allocator.enable_prefix_caching:
             if not self.allocator.can_allocate(total):
                 return False
+            # ray-tpu: lint-ignore[RTL404] the free() below belongs to the
+            # prefix-caching branch; this branch allocates (pre-checked
+            # above, cannot raise) and returns with the blocks owned
             seq.block_table = self.allocator.allocate(total)
             seq.block_hashes = []
             seq.num_cached = 0
@@ -179,6 +182,9 @@ class Scheduler:
         need = total - k + (1 if cow else 0)
         # Shield the matched prefix from being evicted by the tail
         # allocation below (and from anyone else while this seq runs).
+        # ray-tpu: lint-ignore[RTL404] nothing between touch and the
+        # failure-path free can raise (can_allocate is a pure check and
+        # allocate is pre-checked); the engine lock serializes callers
         self.allocator.touch(matched)
         if not self.allocator.can_allocate(need):
             self.allocator.free(matched)

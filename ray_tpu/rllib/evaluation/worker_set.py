@@ -100,6 +100,9 @@ class EnvRunnerGroup:
             if restore:
                 runner = self._make_remote(idx)
                 if self._weights is not None:
+                    # ray-tpu: lint-ignore[RTL401] fire-and-forget weight
+                    # seed for the replacement runner; a failed push just
+                    # means stale weights until the next sync_weights
                     runner.set_weights.remote(self._weights)
                 self._remote[idx] = runner
 
@@ -124,6 +127,9 @@ class EnvRunnerGroup:
         if targets:
             ref = ray_tpu.put(weights)
             for runner in targets.values():
+                # ray-tpu: lint-ignore[RTL401] broadcast is deliberately
+                # fire-and-forget (reference WorkerSet does the same);
+                # runner failures surface on the next sample() poll
                 runner.set_weights.remote(ref, global_vars)
         self._sync_obs_filters(to)
 
@@ -162,6 +168,8 @@ class EnvRunnerGroup:
         if self.local_runner is not None:
             self.local_runner.set_filter_state(state)
         for runner in targets.values():
+            # ray-tpu: lint-ignore[RTL401] filter-state broadcast is
+            # fire-and-forget; stats re-merge on the next delta sweep
             runner.set_filter_state.remote(state)
 
     def get_filter_state(self) -> Optional[dict]:
@@ -180,6 +188,8 @@ class EnvRunnerGroup:
         if self.local_runner is not None:
             self.local_runner.set_filter_state(state)
         for runner in self._remote.values():
+            # ray-tpu: lint-ignore[RTL401] checkpoint-restore broadcast is
+            # fire-and-forget; stats re-merge on the next delta sweep
             runner.set_filter_state.remote(state)
 
     def remote_runners(self) -> dict:

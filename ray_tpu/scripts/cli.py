@@ -231,7 +231,30 @@ def cmd_start(args) -> int:
     return 0
 
 
+def _forward_lint(rest: list) -> int:
+    """Hand everything after `lint` to the analyzer's own parser. Pure
+    AST pass — never boots a runtime. See ray_tpu/tools/lint and the
+    README "Static analysis" section."""
+    from ray_tpu.tools.lint.cli import main as lint_main
+
+    rest = list(rest)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    return lint_main(rest)
+
+
+def cmd_lint(args) -> int:
+    return _forward_lint(args.lint_args)
+
+
 def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        # Forward verbatim when `lint` leads: the analyzer owns its flags
+        # (`ray-tpu lint --json` must not be eaten by this parser —
+        # argparse.REMAINDER only engages after a positional). With global
+        # flags before the subcommand, argparse dispatches to cmd_lint.
+        return _forward_lint(argv[1:])
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="TPU-native distributed ML framework CLI"
     )
@@ -268,6 +291,18 @@ def main(argv: Optional[list] = None) -> int:
 
     sub.add_parser("metrics", help="prometheus exposition dump")
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="static analysis: races, async deadlocks, jit trace-safety",
+    )
+    p_lint.add_argument(
+        "lint_args",
+        nargs=argparse.REMAINDER,
+        help="paths and flags forwarded to the analyzer "
+        "(--rule ID, --json, --baseline FILE, --write-baseline, "
+        "--list-rules)",
+    )
+
     p_logs = sub.add_parser("logs", help="tail aggregated worker logs")
     p_logs.add_argument(
         "--address", required=True, help="head connect string host:port?token=..."
@@ -302,6 +337,7 @@ def main(argv: Optional[list] = None) -> int:
         "timeline": cmd_timeline,
         "job": cmd_job,
         "metrics": cmd_metrics,
+        "lint": cmd_lint,
         "start": cmd_start,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,

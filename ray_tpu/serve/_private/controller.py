@@ -104,6 +104,11 @@ class ServeControllerActor:
                     if d["config"].user_config is not None:
                         for h in list(existing.replicas.values()):
                             try:
+                                # ray-tpu: lint-ignore[RTL401] deliberate
+                                # fire-and-forget: config push must not
+                                # block the deploy RPC; a replica that
+                                # missed it fails health checks and is
+                                # replaced with the new config anyway
                                 h.reconfigure.remote(d["config"].user_config)
                             except Exception:
                                 pass
@@ -158,11 +163,15 @@ class ServeControllerActor:
 
     def listen_for_change(self, known_version: int, timeout_s: float = 10.0):
         """Block until cluster state version advances past known_version
-        (reference long-poll: serve/_private/long_poll.py:186)."""
-        deadline = time.time() + timeout_s
+        (reference long-poll: serve/_private/long_poll.py:186).
+
+        Monotonic deadline: a backward NTP step used to recede the
+        wall-clock deadline and park the poller (and its actor thread)
+        far past timeout_s (found by lint RTL302)."""
+        deadline = time.monotonic() + timeout_s
         with self._cv:
             while self._version <= known_version and not self._shutdown:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cv.wait(remaining)
@@ -202,9 +211,12 @@ class ServeControllerActor:
     # ---------------- reconciliation ----------------
 
     def _get_state(self, app: str, deployment: str) -> Optional[_DeploymentState]:
+        """Caller must hold self._lock."""
         return self._apps.get(app, {}).get(deployment)
 
     def _reconcile_loop(self) -> None:
+        # ray-tpu: lint-ignore[RTL201] daemon-loop poll of an atomic bool;
+        # a stale read only delays exit by one reconcile period
         while not self._shutdown:
             try:
                 self._reconcile_once()

@@ -39,7 +39,9 @@ class ReplicaActor:
         self._lock = threading.Lock()
         self._num_ongoing = 0
         self._num_processed = 0
-        self._start_time = time.time()
+        # Monotonic: uptime_s is a duration, and wall-clock steps would
+        # make it jump (or go negative) in the metrics.
+        self._start_time = time.monotonic()
 
         if inspect.isclass(callable_def):
             self._callable = callable_def(*init_args, **init_kwargs)
@@ -201,7 +203,7 @@ class ReplicaActor:
                 "replica_tag": self._replica_tag,
                 "num_ongoing_requests": self._num_ongoing,
                 "num_processed": self._num_processed,
-                "uptime_s": time.time() - self._start_time,
+                "uptime_s": time.monotonic() - self._start_time,
             }
 
     def check_health(self) -> bool:

@@ -195,8 +195,8 @@ def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
 
     from ray_tpu import api as ray
 
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         st = ray.get(controller.get_status.remote())
         app = st.get(app_name, {})
         if app and all(d["status"] == "HEALTHY" for d in app.values()):

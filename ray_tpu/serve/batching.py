@@ -81,6 +81,7 @@ class _BatchQueue:
         return item.result
 
     def _drain(self) -> list[_Item]:
+        """Caller must hold self._lock."""
         batch, self._pending = self._pending, []
         return batch
 

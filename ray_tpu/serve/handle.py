@@ -390,18 +390,28 @@ class Router:
         last_push = 0.0
         while not self._closed:
             try:
+                # Snapshot the version under the lock: _refresh writes it
+                # under self._lock, and a torn read here would long-poll
+                # with a stale version and miss one replica-set update
+                # (found by lint RTL201).
+                with self._lock:
+                    known_version = self._version
                 new_version = ray.get(
                     self._controller().listen_for_change.remote(
-                        self._version, 1.0
+                        known_version, 1.0
                     ),
                     timeout=5.0,
                 )
-                if new_version != self._version:
+                if new_version != known_version:
                     self._refresh()
-                now = time.time()
+                now = time.monotonic()
                 if now - last_push > self.METRICS_PUSH_PERIOD_S:
                     with self._lock:
                         queued = self._queued + sum(self._in_flight.values())
+                    # ray-tpu: lint-ignore[RTL401] metrics push is
+                    # fire-and-forget by design: losing one sample is
+                    # harmless and the poll loop must never block on the
+                    # controller
                     self._controller().record_handle_metrics.remote(
                         self._app, self._deployment, self._handle_id, queued
                     )
@@ -555,7 +565,9 @@ class Router:
         prefer: str = None,
         excluded: frozenset = frozenset(),
     ):
-        deadline = time.time() + timeout_s
+        # Monotonic deadline: an NTP step while blocked here would stretch
+        # or truncate the replica wait arbitrarily (found by lint RTL302).
+        deadline = time.monotonic() + timeout_s
         with self._lock:
             while True:
                 available = [
@@ -587,7 +599,7 @@ class Router:
                     )
                     self._in_flight[tag] = self._in_flight.get(tag, 0) + 1
                     return tag, h
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
                         f"No available replica for {self._deployment} within "

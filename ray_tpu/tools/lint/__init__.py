@@ -1,0 +1,27 @@
+"""ray-tpu lint: codebase-aware static analyzer.
+
+Four rule families tuned to this repo's hazard classes (every one of
+which previously shipped a hand-found bug — see CHANGES.md):
+
+  * async (RTL1xx)     — blocking calls in `async def`, await while
+                         holding a threading lock, unawaited coroutines
+  * locks (RTL2xx)     — per-class lock-coverage inference: state mutated
+                         under `self._lock` accessed bare elsewhere
+  * trace (RTL3xx)     — host side effects / state mutation inside
+                         `jax.jit`/`pjit`/`shard_map` functions, and
+                         wall-clock duration/deadline arithmetic
+  * resources (RTL4xx) — dropped ObjectRefs, rollback markers cleared
+                         before commit, allocate/free exception safety
+
+Entry points: `ray-tpu lint`, `python -m ray_tpu.tools.lint`, or
+`lint_source()` / `lint_paths()` from Python (tests use both).
+"""
+
+from ray_tpu.tools.lint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    all_rules,
+    find_repo_root,
+    lint_paths,
+    lint_source,
+)

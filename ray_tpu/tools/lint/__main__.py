@@ -1,0 +1,6 @@
+"""`python -m ray_tpu.tools.lint ray_tpu/` — the CI gate entry point."""
+
+from ray_tpu.tools.lint.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,246 @@
+"""`ray-tpu lint` — CLI for the codebase-aware static analyzer.
+
+    ray-tpu lint [paths ...] [--rule ID] [--json] [--baseline FILE]
+                 [--write-baseline] [--list-rules] [--no-baseline]
+
+Exit codes: 0 — clean (every finding fixed, suppressed with a reason, or
+baselined with a reason); 1 — active findings (or untriaged baseline
+entries); 2 — usage/parse errors.
+
+`--json` emits a machine-readable report (consumed by the dashboard and
+tests):
+
+    {
+      "version": 1,
+      "root": "/abs/repo",
+      "paths": ["ray_tpu"],
+      "files_scanned": 240,
+      "duration_s": 1.8,
+      "counts": {"active": 0, "baselined": 12, "suppressed": 4,
+                 "parse_errors": 0, "stale_baseline": 0},
+      "findings": [ {rule, name, family, path, line, col, context,
+                     message, fingerprint}, ... ],
+      "parse_errors": [ {...}, ... ],
+      "baselined": [ {... , "reason": "..."}, ... ],
+      "suppressed": [ {... , "reason": "..."}, ... ]
+    }
+
+`counts.active == len(findings)` always; unparseable files are reported
+in their own `parse_errors` array (counted by `counts.parse_errors`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from ray_tpu.tools.lint import baseline as baseline_mod
+from ray_tpu.tools.lint.core import (
+    all_rules,
+    find_repo_root,
+    lint_paths,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu lint",
+        description=(
+            "Codebase-aware static analyzer: actor races, async "
+            "deadlocks, JIT trace-safety, resource hygiene"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["ray_tpu"],
+        help="files or directories to scan (default: ray_tpu)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="ID",
+        help="run only this rule id/name (repeatable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file (default: LINT_BASELINE.json at the repo root)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help=(
+            "write current active findings into the baseline with TODO "
+            "reasons (replace them before committing)"
+        ),
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:24s} [{rule.family}] "
+                  f"{rule.description}")
+        return 0
+
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"ray-tpu lint: no such path: {missing[0]}", file=sys.stderr)
+        return 2
+    root = find_repo_root(paths[0])
+    baseline_path = (
+        Path(args.baseline)
+        if args.baseline
+        else root / baseline_mod.BASELINE_FILENAME
+    )
+    baseline = (
+        {} if args.no_baseline else baseline_mod.load_baseline(baseline_path)
+    )
+
+    result = lint_paths(
+        paths, rule_ids=args.rule, baseline=baseline, root=root
+    )
+
+    if args.write_baseline:
+        # Start from the file on disk, not the (possibly --no-baseline'd
+        # or filtered) view used for the scan: entries outside this run's
+        # scope must survive, and already-written reasons must never be
+        # re-stamped with TODO.
+        existing = baseline_mod.load_baseline(baseline_path)
+        for f, _ in result.baselined:
+            if f.fingerprint in existing:
+                existing[f.fingerprint]["line"] = f.line
+        new = 0
+        for f in result.findings:
+            prior = existing.get(f.fingerprint)
+            if prior is not None:
+                prior["line"] = f.line
+            else:
+                existing[f.fingerprint] = baseline_mod.entry_for(f)
+                new += 1
+        # Drop stale entries (the finding no longer exists) — but only
+        # those this run could have re-produced: a scan scoped by path or
+        # --rule must not discard the rest of the baseline, and a file
+        # that failed to PARSE this run produced no findings at all, so
+        # its triaged entries (and their written reasons) must survive.
+        produced = {f.fingerprint for f in result.findings} | {
+            f.fingerprint for f, _ in result.baselined
+        }
+        parse_failed = {f.path for f in result.parse_errors}
+        scan_roots = [p.resolve() for p in paths]
+        wanted = set(args.rule) if args.rule else None
+        scanned_rules = {
+            r.id for r in all_rules()
+            if wanted is None or r.id in wanted or r.name in wanted
+        }
+
+        def in_scope(entry: dict) -> bool:
+            if entry["rule"] not in scanned_rules:
+                return False
+            entry_path = (root / entry["path"]).resolve()
+            return any(
+                entry_path == sr or sr in entry_path.parents
+                for sr in scan_roots
+            )
+
+        entries = [
+            e for fp, e in existing.items()
+            if fp in produced
+            or e["path"] in parse_failed
+            or not in_scope(e)
+        ]
+        baseline_mod.save_baseline(baseline_path, entries)
+        print(
+            f"wrote {len(entries)} entries to {baseline_path} "
+            f"({new} new with TODO reasons)"
+        )
+        return 0
+
+    untriaged = baseline_mod.untriaged(
+        {
+            f.fingerprint: baseline[f.fingerprint]
+            for f, _ in result.baselined
+            if f.fingerprint in baseline
+        }
+    )
+
+    if args.json:
+        report = {
+            "version": 1,
+            "root": str(root),
+            "paths": [str(p) for p in paths],
+            "files_scanned": result.files_scanned,
+            "duration_s": round(result.duration_s, 3),
+            "counts": {
+                "active": len(result.findings),
+                "baselined": len(result.baselined),
+                "suppressed": len(result.suppressed),
+                "parse_errors": len(result.parse_errors),
+                "stale_baseline": len(result.stale_baseline),
+                "untriaged_baseline": len(untriaged),
+            },
+            "findings": [f.to_dict() for f in result.findings],
+            "parse_errors": [f.to_dict() for f in result.parse_errors],
+            "baselined": [
+                {**f.to_dict(), "reason": reason}
+                for f, reason in result.baselined
+            ],
+            "suppressed": [
+                {**f.to_dict(), "reason": reason}
+                for f, reason in result.suppressed
+            ],
+        }
+        print(json.dumps(report, indent=2))
+    else:
+        for f in result.parse_errors + result.findings:
+            print(
+                f"{f.path}:{f.line}:{f.col}: {f.rule} {f.name} "
+                f"[{f.family}] {f.message} ({f.context})"
+            )
+        for entry in untriaged:
+            print(
+                f"{entry['path']}:{entry.get('line', 0)}: {entry['rule']} "
+                f"baseline entry has no written reason ({entry['reason']!r})"
+            )
+        summary = (
+            f"{len(result.findings)} finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.parse_errors)} parse error(s) in "
+            f"{result.files_scanned} files "
+            f"({result.duration_s:.2f}s)"
+        )
+        if result.stale_baseline:
+            summary += (
+                f"; {len(result.stale_baseline)} stale baseline entr"
+                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                "(regenerate with --write-baseline)"
+            )
+        print(summary)
+
+    # Stale entries fail too: the CI gate rejects them, so a local run
+    # must not report clean and then break in CI.
+    if (
+        result.findings
+        or result.parse_errors
+        or untriaged
+        or result.stale_baseline
+    ):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,569 @@
+"""Core of `ray-tpu lint`: findings, module model, rule registry, runner.
+
+A rule is a class with an `id` (stable, e.g. "RTL201"), a short `name`,
+a `family` (async / locks / trace / resources) and a `check(module)`
+returning findings. Rules work on a `ModuleInfo` — one parsed file plus
+the derived maps every rule needs (import aliases, AST parent links,
+inline suppressions) so each rule stays a focused AST pass.
+
+Suppression idiom (reason is REQUIRED — an unexplained ignore is itself
+reported as RTL002):
+
+    do_risky_thing()  # ray-tpu: lint-ignore[RTL201] probe reads a stale
+                      # bool at worst; the lock would serialize the loop
+
+A standalone suppression comment applies to the next code line. Findings
+neither fixed nor suppressible inline live in the checked-in baseline
+(see baseline.py) with a written reason per entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+FAMILIES = ("meta", "async", "locks", "trace", "resources")
+
+SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist", "node_modules"}
+SKIP_FILE_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ray-tpu:\s*lint-ignore\[([^\]]*)\]\s*(.*)$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    name: str
+    family: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    context: str  # dotted qualname of the enclosing scope
+    message: str
+    fingerprint: str = ""
+
+    def key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _fingerprint(rule: str, path: str, context: str, line_text: str,
+                 occurrence: int) -> str:
+    # Line NUMBERS drift with every edit; the fingerprint hashes the rule,
+    # file, enclosing scope and the normalized source text instead, so a
+    # baseline survives unrelated churn above the finding.
+    normalized = "".join(line_text.split())
+    payload = f"{rule}|{path}|{context}|{normalized}|{occurrence}"
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+class Suppression:
+    __slots__ = ("line", "ids", "reason", "used")
+
+    def __init__(self, line: int, ids: set, reason: str):
+        self.line = line
+        self.ids = ids
+        self.reason = reason
+        self.used = False
+
+    def matches(self, finding: Finding) -> bool:
+        return "*" in self.ids or finding.rule in self.ids or (
+            finding.name in self.ids
+        )
+
+
+def _matching_suppression(
+    sups: Optional[List[Suppression]], finding: Finding
+) -> Optional[Suppression]:
+    """First suppression on the finding's line that names its rule AND
+    carries a reason. RTL002 (reasonless ignore) is never suppressible."""
+    if not sups or finding.rule == "RTL002":
+        return None
+    for sup in sups:
+        if sup.reason and sup.matches(finding):
+            return sup
+    return None
+
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleInfo:
+    """One parsed source file plus the shared derived structure.
+
+    Everything rules repeatedly need is computed in ONE traversal:
+    parent links, a by-type node index, and scope ownership (each node
+    mapped to its nearest enclosing function/lambda/module), so rules
+    never re-walk the whole tree. A per-module memo dict lets rules
+    share expensive derived maps (lock attrs, jitted functions)."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parents: Dict[int, ast.AST] = {}
+        self.by_type: Dict[type, List[ast.AST]] = {}
+        # scope node (Module/FunctionDef/AsyncFunctionDef/Lambda) id ->
+        # nodes owned directly by that scope (not by a nested scope).
+        self.scope_nodes: Dict[int, List[ast.AST]] = {id(self.tree): []}
+        self.scopes: List[ast.AST] = [self.tree]
+        self.memo: Dict[str, object] = {}
+        stack = [(self.tree, self.tree)]
+        while stack:
+            node, scope = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+                self.by_type.setdefault(type(child), []).append(child)
+                child_scope = scope
+                if isinstance(child, _SCOPE_TYPES):
+                    self.scopes.append(child)
+                    self.scope_nodes[id(child)] = []
+                    child_scope = child
+                else:
+                    self.scope_nodes[id(scope)].append(child)
+                stack.append((child, child_scope))
+        # name -> dotted module ("np" -> "numpy"); from-imports map the
+        # bound name to "module.attr" ("jit" -> "jax.jit").
+        self.aliases: Dict[str, str] = {}
+        for node in self.nodes(ast.Import):
+            for a in node.names:
+                self.aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        for node in self.nodes(ast.ImportFrom):
+            if not node.module:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.suppressions = self._parse_suppressions()
+        self._expand_suppressions()
+
+    def nodes(self, *types: type) -> List[ast.AST]:
+        if len(types) == 1:
+            return self.by_type.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self.by_type.get(t, []))
+        return out
+
+    def own_nodes(self, scope: ast.AST) -> List[ast.AST]:
+        """Nodes owned directly by `scope`, excluding nested functions."""
+        return self.scope_nodes.get(id(scope), [])
+
+    # -- suppressions -------------------------------------------------------
+
+    def _parse_suppressions(self) -> Dict[int, List[Suppression]]:
+        # A list per line: several standalone lint-ignore comments stacked
+        # above one statement all resolve to that statement's line, and
+        # each must keep its own ids + reason.
+        # Lines inside multi-line string literals are string CONTENT, not
+        # comments — a docstring showing the idiom must not register.
+        in_string: set = set()
+        for node in self.nodes(ast.Constant):
+            if (
+                isinstance(node.value, str)
+                and getattr(node, "end_lineno", node.lineno) > node.lineno
+            ):
+                in_string.update(range(node.lineno, node.end_lineno + 1))
+        out: Dict[int, List[Suppression]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            if i in in_string:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            reason = m.group(2).strip()
+            line = i
+            if text.lstrip().startswith("#"):
+                # Standalone comment: applies to the next code line.
+                j = i + 1
+                while j <= len(self.lines) and (
+                    not self.lines[j - 1].strip()
+                    or self.lines[j - 1].lstrip().startswith("#")
+                ):
+                    j += 1
+                line = j
+            out.setdefault(line, []).append(Suppression(line, ids, reason))
+        return out
+
+    def _expand_suppressions(self) -> None:
+        """Extend each suppression across the statement it anchors to, so
+        an ignore above a black-wrapped expression reaches findings whose
+        AST node sits on a continuation line. Compound statements extend
+        over their HEADER only (`with`/`if`/`def` lines up to the first
+        body statement) — an ignore must never blanket a whole block."""
+        if not self.suppressions:
+            return
+        spans: Dict[int, int] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            body = getattr(node, "body", None)
+            if isinstance(body, list) and body and hasattr(body[0], "lineno"):
+                end = max(node.lineno, body[0].lineno - 1)
+            else:
+                end = getattr(node, "end_lineno", None) or node.lineno
+            prev = spans.get(node.lineno)
+            spans[node.lineno] = end if prev is None else max(prev, end)
+        for line, sups in list(self.suppressions.items()):
+            for extra in range(line + 1, spans.get(line, line) + 1):
+                self.suppressions.setdefault(extra, []).extend(sups)
+
+    def suppression_findings(self) -> List[Finding]:
+        """RTL002: a lint-ignore with no written reason is not a valid
+        suppression (and does not suppress anything)."""
+        out = []
+        # Expansion aliases one Suppression onto several lines — report
+        # each object once, at its anchor.
+        unique = {
+            id(s): s for sups in self.suppressions.values() for s in sups
+        }
+        for sup in unique.values():
+            if not sup.reason:
+                out.append(
+                    Finding(
+                        rule="RTL002",
+                        name="suppression-missing-reason",
+                        family="meta",
+                        path=self.relpath,
+                        line=sup.line,
+                        col=0,
+                        context="<module>",
+                        message=(
+                            "lint-ignore without a reason; write why the "
+                            "finding is a false positive after the bracket"
+                        ),
+                    )
+                )
+        return out
+
+    # -- resolution helpers -------------------------------------------------
+
+    def dotted_name(self, node: ast.AST) -> Optional[str]:
+        """`a.b.c` for an Attribute/Name chain, with the root mapped
+        through the module's import aliases. None for dynamic receivers."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def call_target(self, call: ast.Call) -> Optional[str]:
+        return self.dotted_name(call.func)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+def qualname_of(module: ModuleInfo, node: ast.AST) -> str:
+    """Dotted path of the scopes enclosing `node` (classes + functions)."""
+    parts: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            parts.append(cur.name)
+        cur = module.parent(cur)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+class Rule:
+    id = "RTL000"
+    name = "abstract"
+    family = "meta"
+    description = ""
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, module: ModuleInfo, node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.id,
+            name=self.name,
+            family=self.family,
+            path=module.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            context=qualname_of(module, node),
+            message=message,
+        )
+
+
+def all_rules() -> List[Rule]:
+    from ray_tpu.tools.lint import (  # noqa: PLC0415 — avoid import cycle
+        rules_async,
+        rules_locks,
+        rules_resources,
+        rules_trace,
+    )
+
+    rules: List[Rule] = []
+    for mod in (rules_async, rules_locks, rules_trace, rules_resources):
+        rules.extend(r() for r in mod.RULES)
+    return rules
+
+
+def iter_python_files(paths: Sequence[Path]) -> Iterable[Path]:
+    # Overlapping scan paths (`lint ray_tpu ray_tpu/_private`) must not
+    # yield a file twice: duplicate findings get occurrence-shifted
+    # fingerprints that no longer match the baseline.
+    seen = set()
+    for path in paths:
+        if path.is_file():
+            if path.suffix == ".py" and path.resolve() not in seen:
+                seen.add(path.resolve())
+                yield path
+            continue
+        for sub in sorted(path.rglob("*.py")):
+            # Only components BELOW the scan root count: a checkout that
+            # happens to live under ~/.cache or a dir named `build` must
+            # not make the whole scan vacuously clean.
+            if any(part in SKIP_DIRS or part.startswith(".")
+                   for part in sub.relative_to(path).parts):
+                continue
+            if sub.name.endswith(SKIP_FILE_SUFFIXES):
+                continue
+            resolved = sub.resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            yield sub
+
+
+def find_repo_root(start: Path) -> Path:
+    """Directory the baseline lives in: nearest ancestor (of the first
+    scanned path) holding a pyproject.toml, else the CWD."""
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for candidate in (cur, *cur.parents):
+        if (candidate / "pyproject.toml").exists():
+            return candidate
+    return Path.cwd()
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]  # active (not suppressed, not baselined)
+    suppressed: List[Tuple[Finding, str]]  # (finding, reason)
+    baselined: List[Tuple[Finding, str]]
+    parse_errors: List[Finding]
+    files_scanned: int
+    duration_s: float
+    stale_baseline: List[str] = dataclasses.field(default_factory=list)
+
+
+def _unused_suppression_findings(
+    suppressions: Dict[int, List[Suppression]], relpath: str
+) -> List[Finding]:
+    """RTL003: a reasoned lint-ignore whose finding no longer fires is
+    rot — the hazard was fixed (delete the comment) or the comment
+    drifted off the flagged statement (it no longer protects anything).
+    Only meaningful when the FULL rule registry ran: under --rule the
+    other rules' suppressions legitimately match nothing."""
+    out = []
+    unique = {id(s): s for sups in suppressions.values() for s in sups}
+    for sup in unique.values():
+        if sup.reason and not sup.used:
+            out.append(
+                Finding(
+                    rule="RTL003",
+                    name="unused-suppression",
+                    family="meta",
+                    path=relpath,
+                    line=sup.line,
+                    col=0,
+                    context="<module>",
+                    message=(
+                        "lint-ignore["
+                        + ",".join(sorted(sup.ids))
+                        + "] suppresses nothing; delete it or re-anchor "
+                        "it to the flagged statement"
+                    ),
+                )
+            )
+    return out
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    rules: Optional[Sequence[Rule]] = None,
+    rule_ids: Optional[Sequence[str]] = None,
+    baseline: Optional[dict] = None,
+    root: Optional[Path] = None,
+) -> LintResult:
+    t0 = time.perf_counter()
+    full_run = rules is None and not rule_ids
+    rules = list(rules) if rules is not None else all_rules()
+    if rule_ids:
+        wanted = set(rule_ids)
+        rules = [r for r in rules if r.id in wanted or r.name in wanted]
+    root = root or find_repo_root(Path(paths[0]))
+    baseline = baseline or {}
+
+    raw: List[Finding] = []
+    parse_errors: List[Finding] = []
+    suppressions_by_file: Dict[str, Dict[int, List[Suppression]]] = {}
+    lines_by_file: Dict[str, List[str]] = {}
+    n_files = 0
+    for file in iter_python_files([Path(p) for p in paths]):
+        n_files += 1
+        try:
+            relpath = file.resolve().relative_to(root).as_posix()
+        except ValueError:
+            relpath = file.as_posix()
+        try:
+            module = ModuleInfo(file, relpath, file.read_text())
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            parse_errors.append(
+                Finding(
+                    rule="RTL001",
+                    name="parse-error",
+                    family="meta",
+                    path=relpath,
+                    line=getattr(exc, "lineno", 0) or 0,
+                    col=0,
+                    context="<module>",
+                    message=f"could not parse: {exc}",
+                )
+            )
+            continue
+        suppressions_by_file[relpath] = module.suppressions
+        lines_by_file[relpath] = module.lines
+        raw.extend(module.suppression_findings())
+        for rule in rules:
+            raw.extend(rule.check(module))
+
+    raw.sort(key=Finding.key)
+    # Occurrence-stable fingerprints for findings that normalize to the
+    # same source text within one scope.
+    seen: Dict[tuple, int] = {}
+    for f in raw:
+        lines = lines_by_file.get(f.path, [])
+        line_text = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        base = (f.rule, f.path, f.context, "".join(line_text.split()))
+        occ = seen.get(base, 0)
+        seen[base] = occ + 1
+        f.fingerprint = _fingerprint(f.rule, f.path, f.context, line_text, occ)
+
+    active: List[Finding] = []
+    suppressed: List[Tuple[Finding, str]] = []
+    baselined: List[Tuple[Finding, str]] = []
+    produced = set()
+    for f in raw:
+        produced.add(f.fingerprint)
+        sup = _matching_suppression(
+            suppressions_by_file.get(f.path, {}).get(f.line), f
+        )
+        if sup is not None:
+            sup.used = True
+            suppressed.append((f, sup.reason))
+            continue
+        if f.fingerprint in baseline:
+            baselined.append((f, baseline[f.fingerprint].get("reason", "")))
+            continue
+        active.append(f)
+
+    if full_run:
+        # Orphaned suppressions are only knowable after every rule had
+        # its chance to match them, so they classify here (baseline
+        # honored; inline self-suppression would be circular, skipped).
+        orphans: List[Finding] = []
+        for relpath, sups in suppressions_by_file.items():
+            orphans.extend(_unused_suppression_findings(sups, relpath))
+        orphans.sort(key=Finding.key)
+        for f in orphans:
+            lines = lines_by_file.get(f.path, [])
+            line_text = (
+                lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+            )
+            base = (f.rule, f.path, f.context, "".join(line_text.split()))
+            occ = seen.get(base, 0)
+            seen[base] = occ + 1
+            f.fingerprint = _fingerprint(
+                f.rule, f.path, f.context, line_text, occ
+            )
+            produced.add(f.fingerprint)
+            if f.fingerprint in baseline:
+                baselined.append(
+                    (f, baseline[f.fingerprint].get("reason", ""))
+                )
+            else:
+                active.append(f)
+        active.sort(key=Finding.key)
+
+    # Stale = the scan COULD have re-produced the entry (its file was
+    # scanned with its rule active) and did not. A path- or rule-scoped
+    # run must not report the rest of the baseline as stale.
+    scanned_rule_ids = {r.id for r in rules}
+    scanned_relpaths = set(lines_by_file)
+    stale = [
+        fp for fp, entry in baseline.items()
+        if fp not in produced
+        and entry.get("rule") in scanned_rule_ids
+        and entry.get("path") in scanned_relpaths
+    ]
+    return LintResult(
+        findings=active,
+        suppressed=suppressed,
+        baselined=baselined,
+        parse_errors=parse_errors,
+        files_scanned=n_files,
+        duration_s=time.perf_counter() - t0,
+        stale_baseline=stale,
+    )
+
+
+def lint_source(
+    source: str,
+    rules: Optional[Sequence[Rule]] = None,
+    relpath: str = "<snippet>.py",
+) -> List[Finding]:
+    """Run rules on an in-memory snippet (test harness entry point);
+    returns ALL findings, honoring inline suppressions but no baseline."""
+    module = ModuleInfo(Path(relpath), relpath, source)
+    full_run = rules is None
+    rules = list(rules) if rules is not None else all_rules()
+    raw = list(module.suppression_findings())
+    for rule in rules:
+        raw.extend(rule.check(module))
+    raw.sort(key=Finding.key)
+    out = []
+    for f in raw:
+        sup = _matching_suppression(module.suppressions.get(f.line), f)
+        if sup is not None:
+            sup.used = True
+            continue
+        out.append(f)
+    if full_run:
+        out.extend(
+            _unused_suppression_findings(module.suppressions, relpath)
+        )
+        out.sort(key=Finding.key)
+    return out

@@ -1,0 +1,421 @@
+"""Family 3 — JIT trace-safety + clock-discipline rules.
+
+RTL301: host side effects inside a function handed to `jax.jit` / `pjit`
+/ `shard_map` (including `@jax.jit`, `@partial(jax.jit, ...)` and
+`jax_compat` wrapper forms). Side effects run ONCE at trace time and
+never again — `time.time()`, host `random`, metric writes and `print`
+inside a jitted function silently produce wrong-but-fast programs
+(the constant from trace time is baked into the compiled executable).
+
+RTL303: mutation of closed-over / self state inside a jitted function —
+same trace-once hazard for state instead of values.
+
+RTL302: durations or deadlines computed from `time.time()`. Wall clock
+steps under NTP/suspend, so `deadline = time.time() + t` can hang or
+fire early; `time.time() - t0` durations jitter. Use
+`time.monotonic()`/`perf_counter()` unless wall-clock *identity* is
+required (timestamps that are compared across processes, e.g. trace
+spans).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.tools.lint.core import Finding, ModuleInfo, Rule
+
+JIT_WRAPPER_SUFFIXES = ("jit", "pjit", "pmap", "shard_map")
+
+IMPURE_CALL_PREFIXES = (
+    "time.",
+    "random.",
+    "numpy.random.",
+    "uuid.",
+    "logging.",
+)
+PURE_TIME_EXCEPTIONS: Set[str] = set()  # all of time.* is host-side
+IMPURE_BARE_CALLS = {"print", "open", "input"}
+IMPURE_METHOD_CALLS = {"inc", "observe"}  # util.metrics write API
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "remove",
+    "discard", "clear", "pop", "popleft", "popitem", "put",
+}
+
+
+def _is_jit_wrapper(module: ModuleInfo, func: ast.AST) -> bool:
+    dotted = module.dotted_name(func)
+    if dotted is None:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    if last not in JIT_WRAPPER_SUFFIXES:
+        return False
+    if last in ("pjit", "shard_map", "pmap"):
+        return True
+    # Bare `jit`: require a jax-ish origin so `obj.jit` elsewhere (or a
+    # local helper named jit) doesn't fire.
+    return dotted.startswith("jax.") or dotted.endswith(".jit") and (
+        "jax" in dotted
+    )
+
+
+def _jitted_function_args(module: ModuleInfo, call: ast.Call):
+    """The function-expression argument(s) of a jit-wrapper call."""
+    out = []
+    if call.args:
+        out.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in ("fun", "f", "func"):
+            out.append(kw.value)
+    return out
+
+
+def _resolve_function(module: ModuleInfo, expr: ast.AST, at: ast.AST):
+    """Map a function expression to a FunctionDef/Lambda defined in this
+    module: a bare name (module function or sibling nested def), a
+    `self._method`, or an inline lambda. None when not resolvable."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Name):
+        # Nearest definition in the lexical scope chain of `at`.
+        scope = module.parent(at)
+        chain = []
+        while scope is not None:
+            chain.append(scope)
+            scope = module.parent(scope)
+        if not chain or chain[-1] is not module.tree:
+            chain.append(module.tree)
+        for scope in chain:
+            for node in ast.walk(scope):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node.name == expr.id:
+                    return node
+        return None
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        cls = module.parent(at)
+        while cls is not None and not isinstance(cls, ast.ClassDef):
+            cls = module.parent(cls)
+        if cls is not None:
+            for node in cls.body:
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node.name == expr.attr:
+                    return node
+    return None
+
+
+def find_jitted_functions(module: ModuleInfo):
+    """(fn_node, wrapper_desc) for every function this module hands to a
+    jit-style wrapper, via call, decorator, or partial-decorator. Memoized
+    per module (two rules consume it)."""
+    cached = module.memo.get("jitted_functions")
+    if cached is not None:
+        return cached
+    out = []
+    seen = set()
+    for node in module.nodes(ast.Call):
+        if _is_jit_wrapper(module, node.func):
+            for arg in _jitted_function_args(module, node):
+                fn = _resolve_function(module, arg, node)
+                if fn is not None and id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append((fn, module.dotted_name(node.func) or "jit"))
+    for node in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        for dec in node.decorator_list:
+            desc = _decorator_jit_desc(module, dec)
+            if desc and id(node) not in seen:
+                seen.add(id(node))
+                out.append((node, desc))
+    module.memo["jitted_functions"] = out
+    return out
+
+
+def _decorator_jit_desc(module: ModuleInfo, dec: ast.AST) -> Optional[str]:
+    if _is_jit_wrapper(module, dec):
+        return module.dotted_name(dec)
+    if isinstance(dec, ast.Call):
+        # @jax.jit(...) / @partial(jax.jit, ...) / @shard_map(...)
+        if _is_jit_wrapper(module, dec.func):
+            return module.dotted_name(dec.func)
+        dotted = module.dotted_name(dec.func)
+        if dotted and dotted.rsplit(".", 1)[-1] == "partial" and dec.args:
+            if _is_jit_wrapper(module, dec.args[0]):
+                return f"partial({module.dotted_name(dec.args[0])}, ...)"
+    return None
+
+
+class JitImpureCallRule(Rule):
+    id = "RTL301"
+    name = "jit-impure-call"
+    family = "trace"
+    description = (
+        "host side effect inside a jitted function runs once at trace "
+        "time and never again"
+    )
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for fn, wrapper in find_jitted_functions(module):
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    label = self._impure_label(module, node)
+                    if label is None:
+                        continue
+                    out.append(
+                        self.finding(
+                            module,
+                            node,
+                            f"{label} inside a function traced by "
+                            f"{wrapper}: it runs once at trace time and "
+                            "is baked into the compiled program",
+                        )
+                    )
+        return out
+
+    def _impure_label(self, module, call: ast.Call) -> Optional[str]:
+        dotted = module.call_target(call)
+        if dotted is not None:
+            if dotted in IMPURE_BARE_CALLS:
+                return f"{dotted}()"
+            for prefix in IMPURE_CALL_PREFIXES:
+                if dotted.startswith(prefix) or dotted == prefix[:-1]:
+                    # jax.random is fine; host random/numpy.random is not.
+                    if dotted.startswith("jax."):
+                        return None
+                    return f"{dotted}()"
+            if dotted.endswith(".maybe_fail") or dotted == "maybe_fail":
+                return "fault-injection hook maybe_fail()"
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in IMPURE_METHOD_CALLS
+        ):
+            return f"metric write .{func.attr}()"
+        return None
+
+
+class JitClosureMutationRule(Rule):
+    id = "RTL303"
+    name = "jit-closure-mutation"
+    family = "trace"
+    description = (
+        "mutating self/global/closed-over state inside a jitted function "
+        "happens at trace time only"
+    )
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for fn, wrapper in find_jitted_functions(module):
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas cannot contain statements
+            local_names = self._local_bindings(fn)
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+                    out.append(
+                        self.finding(
+                            module, stmt,
+                            f"global/nonlocal write inside a function "
+                            f"traced by {wrapper} mutates host state at "
+                            "trace time only",
+                        )
+                    )
+                elif isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        desc = self._store_target_desc(t, local_names)
+                        if desc is not None:
+                            out.append(
+                                self.finding(
+                                    module, t,
+                                    f"{desc} inside a function traced by "
+                                    f"{wrapper} runs at trace time only; "
+                                    "return the value instead",
+                                )
+                            )
+                elif isinstance(stmt, ast.Expr) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    call = stmt.value
+                    func = call.func
+                    if (
+                        isinstance(func, ast.Attribute)
+                        and func.attr in MUTATOR_METHODS
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id not in local_names
+                    ):
+                        out.append(
+                            self.finding(
+                                module, call,
+                                f"{func.value.id}.{func.attr}(...) mutates "
+                                f"closed-over state inside a function "
+                                f"traced by {wrapper} (trace-time only)",
+                            )
+                        )
+        return out
+
+    @staticmethod
+    def _store_target_desc(
+        t: ast.AST, local_names: Set[str]
+    ) -> Optional[str]:
+        """Describe a store target that mutates self / closed-over state,
+        or None when the target is purely local."""
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        ):
+            return f"self.{t.attr} assignment"
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id not in local_names
+            ):
+                return f"subscript write to closed-over {base.id}"
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                return f"subscript write to self.{base.attr}"
+        return None
+
+    @staticmethod
+    def _local_bindings(fn) -> Set[str]:
+        names = {a.arg for a in fn.args.args}
+        names.update(a.arg for a in fn.args.posonlyargs)
+        names.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            names.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            names.add(fn.args.kwarg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                names.add(node.id)
+        return names
+
+
+class WallClockDurationRule(Rule):
+    id = "RTL302"
+    name = "wallclock-duration"
+    family = "trace"
+    description = (
+        "duration/deadline arithmetic on time.time(); use "
+        "time.monotonic()/perf_counter() unless wall-clock identity is "
+        "required"
+    )
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        class_attrs = self._wallclock_self_attrs(module)
+        for scope in module.scopes:
+            if isinstance(scope, ast.Lambda):
+                continue
+            out.extend(self._check_scope(module, scope, class_attrs))
+        return out
+
+    def _wallclock_self_attrs(self, module) -> Set[str]:
+        attrs = set()
+        for node in module.nodes(ast.Assign):
+            if self._is_time_call(module, node.value):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attrs.add(t.attr)
+        return attrs
+
+    def _is_time_call(self, module, expr) -> bool:
+        return (
+            isinstance(expr, ast.Call)
+            and module.call_target(expr) == "time.time"
+        )
+
+    def _check_scope(self, module, scope, class_attrs) -> List[Finding]:
+        # Wall-clock-tainted names in this scope (transitive over simple
+        # assignments), excluding nested function bodies.
+        own_nodes = module.own_nodes(scope)
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in own_nodes:
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self._expr_tainted(module, node.value, tainted):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+        findings = []
+        for node in own_nodes:
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if self._side_tainted(module, node.left, tainted,
+                                      class_attrs) and self._side_tainted(
+                                          module, node.right, tainted,
+                                          class_attrs):
+                    findings.append(self._flag(module, node))
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                if isinstance(node.ops[0], (ast.Lt, ast.LtE, ast.Gt,
+                                            ast.GtE)):
+                    sides = [node.left, node.comparators[0]]
+                    if any(self._is_time_call(module, s) for s in sides) and (
+                        all(
+                            self._side_tainted(module, s, tainted,
+                                               class_attrs)
+                            for s in sides
+                        )
+                    ):
+                        findings.append(self._flag(module, node))
+        return findings
+
+    def _flag(self, module, node) -> Finding:
+        return self.finding(
+            module,
+            node,
+            "duration/deadline computed from time.time(); wall clock "
+            "steps under NTP — use time.monotonic()/perf_counter() "
+            "unless wall-clock identity is required",
+        )
+
+    def _side_tainted(self, module, expr, tainted, class_attrs) -> bool:
+        if self._is_time_call(module, expr):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr in class_attrs
+        return False
+
+    def _expr_tainted(self, module, expr, tainted) -> bool:
+        for node in ast.walk(expr):
+            if self._is_time_call(module, node):
+                return True
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in tainted:
+                return True
+        return False
+
+
+RULES = [JitImpureCallRule, JitClosureMutationRule, WallClockDurationRule]

@@ -26,7 +26,8 @@ class Trainable:
     def __init__(self, config: Optional[dict] = None):
         self.config = config or {}
         self._iteration = 0
-        self._start = time.time()
+        # Monotonic: time_total_s is a duration fed to schedulers/stoppers.
+        self._start = time.monotonic()
         self.setup(self.config)
 
     # -- overridable -----------------------------------------------------
@@ -57,7 +58,7 @@ class Trainable:
         self._iteration += 1
         result.setdefault(DONE, False)
         result[TRAINING_ITERATION] = self._iteration
-        result.setdefault("time_total_s", time.time() - self._start)
+        result.setdefault("time_total_s", time.monotonic() - self._start)
         result.setdefault("trial_id", getattr(self, "trial_id", None))
         return result
 

@@ -166,3 +166,65 @@ def test_hybrid_policy_prefers_head_until_threshold(ray_start_cluster):
     # 4 concurrent 1-CPU tasks on 2+2 CPUs must use both nodes.
     refs = [where.remote() for _ in range(4)]
     assert len(set(ray_tpu.get(refs, timeout=10))) == 2
+
+
+def test_node_state_resource_reads_locked_and_reentrant():
+    """Regression (found by `ray-tpu lint` RTL201 unlocked-attribute):
+    NodeState.feasible / can_allocate / utilization read the resource
+    vectors under the node lock (an unlocked multi-key read could observe
+    a half-applied add_resources and mis-place), and allocate() — which
+    calls the availability check while already holding the non-reentrant
+    lock — must go through the unlocked internal variant, not deadlock."""
+    import threading
+
+    from ray_tpu._private.controller import NodeState
+    from ray_tpu._private.ids import NodeID
+
+    node = NodeState(NodeID(b"\x01" * 16), {"CPU": 4.0, "TPU": 2.0})
+
+    # Reentrancy: allocate() must complete (a lock-taking can_allocate
+    # called under allocate()'s lock would deadlock here forever).
+    done = threading.Event()
+    outcome = {}
+
+    def alloc():
+        outcome["ok"] = node.allocate({"CPU": 1.0})
+        done.set()
+
+    threading.Thread(target=alloc, daemon=True).start()
+    assert done.wait(5.0), "allocate() deadlocked on its own lock"
+    assert outcome["ok"]
+
+    # Hammer: one thread churns the resource vectors while readers score
+    # the node; no read may crash or observe impossible totals.
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        try:
+            while not stop.is_set():
+                node.add_resources({"bundle_0_res": 1.0})
+                node.remove_resources(["bundle_0_res"])
+        except Exception as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    from ray_tpu._private.controller import _place_bundles
+
+    try:
+        for _ in range(2000):
+            assert node.feasible({"CPU": 1.0})
+            node.can_allocate({"CPU": 1.0, "TPU": 1.0})
+            score = node.utilization({"CPU": 1.0})
+            assert 0.0 <= score <= 1.0
+            # PG bin-packing snapshots the resource vectors too: dict()
+            # over a concurrently-resizing available used to raise.
+            assert _place_bundles([{"CPU": 1.0}], "PACK", [node]) is not None
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert not errors
+
+    node.release({"CPU": 1.0})
+    assert node.available["CPU"] == 4.0

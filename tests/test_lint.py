@@ -1,0 +1,1087 @@
+"""Tests for `ray-tpu lint` (ray_tpu/tools/lint).
+
+Unit tests exercise every rule family on synthetic snippets (nested and
+decorated defs, async generators, partial(jax.jit, ...), lock held across
+await, suppression + baseline round-trips), the --json contract, and the
+repo gate: `ray-tpu lint ray_tpu/` must be clean against the checked-in
+baseline, every baseline entry must carry a written reason, and the full
+scan must finish well inside the 10s CI budget.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from ray_tpu.tools.lint import lint_paths, lint_source
+from ray_tpu.tools.lint import baseline as baseline_mod
+from ray_tpu.tools.lint.cli import main as lint_main
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def lint(src, **kwargs):
+    return lint_source(textwrap.dedent(src), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Family 1: async deadlocks
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_get_in_async_def_flagged():
+    findings = lint(
+        """
+        import ray_tpu
+
+        async def handler(ref):
+            return ray_tpu.get(ref)
+        """
+    )
+    assert "RTL101" in rules_of(findings)
+
+
+def test_blocking_calls_via_alias_and_result():
+    findings = lint(
+        """
+        import time
+        from ray_tpu import api as ray
+
+        class A:
+            async def poll(self, ref, fut):
+                time.sleep(1.0)
+                x = ray.get(ref)
+                y = fut.result()
+                return x, y
+        """
+    )
+    assert rules_of(findings).count("RTL101") == 3
+
+
+def test_awaited_and_offloaded_calls_not_flagged():
+    findings = lint(
+        """
+        import asyncio, time
+
+        async def ok(loop, pool, ref):
+            await asyncio.sleep(0.1)
+            # Shipped off-loop: the sanctioned pattern.
+            x = await loop.run_in_executor(None, lambda: do_get(ref))
+            y = await loop.run_in_executor(pool, time.sleep, 1.0)
+            return x, y
+        """
+    )
+    assert "RTL101" not in rules_of(findings)
+
+
+def test_nested_sync_def_inside_async_not_flagged():
+    findings = lint(
+        """
+        import time
+
+        async def outer(pool):
+            def blocking():  # runs wherever it's submitted, not on the loop
+                time.sleep(1.0)
+            return pool.submit(blocking)
+        """
+    )
+    assert "RTL101" not in rules_of(findings)
+
+
+def test_threading_event_wait_in_async_def_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._done = threading.Event()
+
+            async def wait_done(self):
+                self._done.wait()
+        """
+    )
+    assert "RTL101" in rules_of(findings)
+
+
+def test_await_while_holding_threading_lock_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self, coro):
+                with self._lock:
+                    await coro
+
+            async def good(self, coro):
+                with self._lock:
+                    pass
+                await coro
+        """
+    )
+    assert rules_of(findings).count("RTL102") == 1
+    assert findings[0].context.endswith("bad")
+
+
+def test_await_under_local_lock_and_async_gen():
+    findings = lint(
+        """
+        import threading
+
+        async def agen(items):
+            lock = threading.Lock()
+            for item in items:
+                with lock:
+                    yield await item
+        """
+    )
+    assert "RTL102" in rules_of(findings)
+
+
+def test_unawaited_local_coroutine_flagged():
+    findings = lint(
+        """
+        class A:
+            async def _push(self):
+                pass
+
+            def kick(self):
+                self._push()
+
+            async def ok(self):
+                await self._push()
+
+        async def helper():
+            pass
+
+        def fire():
+            helper()
+        """
+    )
+    assert rules_of(findings).count("RTL402") == 2
+
+
+# ---------------------------------------------------------------------------
+# Family 2: lock coverage
+# ---------------------------------------------------------------------------
+
+LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._count = 0
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+                self._count += 1
+
+        def bad_read(self):
+            return len(self._items)
+
+        def good_read(self):
+            with self._lock:
+                return len(self._items)
+
+        def _sum_locked(self):
+            return sum(self._items)
+
+        def _helper(self):
+            \"\"\"Caller must hold self._lock.\"\"\"
+            return list(self._items)
+"""
+
+
+def test_lock_coverage_flags_bare_access_only():
+    findings = lint(LOCKED_CLASS)
+    assert rules_of(findings) == ["RTL201"]
+    assert findings[0].context.endswith("bad_read")
+    assert "_items" in findings[0].message
+
+
+def test_bare_attribute_expression_read_flagged():
+    """Regression: a guarded attribute that IS the whole expression
+    (`return self._x`, `if self._x:`) was misclassified as nested-def
+    and never recorded — the most common bare-read shapes."""
+    findings = lint(
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._open = True
+
+            def add(self):
+                with self._lock:
+                    self._count += 1
+                    self._open = False
+
+            def peek(self):
+                return self._count
+
+            def gate(self):
+                if self._open:
+                    return "open"
+                return "closed"
+        """
+    )
+    assert rules_of(findings) == ["RTL201", "RTL201"]
+    assert {f.context.split(".")[-1] for f in findings} == {"peek", "gate"}
+
+
+def test_condition_alias_counts_as_same_lock():
+    findings = lint(
+        """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._queue = []
+
+            def put(self, x):
+                with self._cv:
+                    self._queue.append(x)
+                    self._cv.notify()
+
+            def drain(self):
+                with self._lock:
+                    out, self._queue = self._queue, []
+                    return out
+        """
+    )
+    assert "RTL201" not in rules_of(findings)
+
+
+def test_unguarded_attrs_and_init_not_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._config = {"a": 1}   # never mutated under the lock
+                self._state = []
+
+            def read_config(self):
+                return self._config["a"]
+
+            def mutate(self):
+                with self._lock:
+                    self._state.append(1)
+        """
+    )
+    assert "RTL201" not in rules_of(findings)
+
+
+def test_setup_style_lock_construction_exempt():
+    # A method that CREATES the lock is init: nothing contends yet.
+    findings = lint(
+        """
+        import threading
+
+        class Algo:
+            def setup(self):
+                self._lock = threading.Lock()
+                self._updates = 0
+
+            def bump(self):
+                with self._lock:
+                    self._updates += 1
+        """
+    )
+    assert "RTL201" not in rules_of(findings)
+
+
+def test_nested_callback_access_not_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def inc(self):
+                with self._lock:
+                    self._n += 1
+
+            def make_cb(self):
+                def cb():
+                    return self._n  # runs on another thread; out of scope
+                return cb
+        """
+    )
+    assert "RTL201" not in rules_of(findings)
+
+
+def test_manual_acquire_flagged():
+    findings = lint(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                do_something()
+                self._lock.release()
+        """
+    )
+    assert "RTL202" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Family 3: JIT trace-safety + clock discipline
+# ---------------------------------------------------------------------------
+
+
+def test_jit_decorator_impurity_flagged():
+    findings = lint(
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x + t
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_partial_jit_decorator_and_host_random():
+    findings = lint(
+        """
+        from functools import partial
+        import jax
+        import numpy as np
+
+        @partial(jax.jit, static_argnums=(1,))
+        def noisy(x, n):
+            return x + np.random.normal(size=n)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_jit_call_form_and_self_method():
+    findings = lint(
+        """
+        import jax
+
+        class Runner:
+            def __init__(self):
+                self._fn = jax.jit(self._step)
+
+            def _step(self, x):
+                print("tracing!")
+                return x * 2
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_shard_map_and_nested_def():
+    findings = lint(
+        """
+        from ray_tpu._private.jax_compat import shard_map
+
+        def build(mesh, specs, metrics):
+            def body(x):
+                metrics.observe(1.0)
+                return x
+            return shard_map(body, mesh=mesh, in_specs=specs, out_specs=specs)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_pure_jax_random_not_flagged():
+    findings = lint(
+        """
+        import jax
+
+        @jax.jit
+        def step(x, rng):
+            noise = jax.random.normal(rng, x.shape)
+            return x + noise
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_jit_closure_mutation_flagged_but_local_ok():
+    findings = lint(
+        """
+        import jax
+
+        log = []
+
+        @jax.jit
+        def bad(x):
+            log.append(x)
+            return x
+
+        @jax.jit
+        def good(x):
+            acc = []
+            acc.append(x)
+            return acc[0]
+        """
+    )
+    assert rules_of(findings).count("RTL303") == 1
+
+
+def test_jit_subscript_and_augassign_mutation_flagged():
+    findings = lint(
+        """
+        import jax
+        import functools
+
+        stats = {"n": 0}
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def bad(n, x):
+            stats["n"] += 1
+            return x * n
+
+        class R:
+            def build(self):
+                self._fn = jax.jit(self._step)
+
+            def _step(self, x):
+                self.cache[0] = x
+                return x
+
+        @jax.jit
+        def good(x):
+            acc = {}
+            acc["y"] = x
+            return acc["y"]
+        """
+    )
+    assert rules_of(findings).count("RTL303") == 2
+
+
+def test_jit_self_assignment_flagged():
+    findings = lint(
+        """
+        import jax
+
+        class R:
+            def build(self):
+                self._fn = jax.jit(self._step)
+
+            def _step(self, x):
+                self.last = x
+                return x
+        """
+    )
+    assert "RTL303" in rules_of(findings)
+
+
+def test_wallclock_deadline_and_duration_flagged():
+    findings = lint(
+        """
+        import time
+
+        def wait_for(pred, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+            return False
+
+        def timed(fn):
+            t0 = time.time()
+            fn()
+            return time.time() - t0
+        """
+    )
+    assert rules_of(findings).count("RTL302") == 2
+
+
+def test_wallclock_identity_not_flagged():
+    findings = lint(
+        """
+        import time
+
+        def stamp(record):
+            record["time"] = time.time()
+            return record
+
+        def duration_ok():
+            t0 = time.perf_counter()
+            return time.perf_counter() - t0
+        """
+    )
+    assert "RTL302" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Family 4: resource hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_object_ref_flagged_and_bound_ok():
+    findings = lint(
+        """
+        def fire(handle):
+            handle.ping.remote()
+
+        def keep(handle):
+            ref = handle.ping.remote()
+            return ref
+        """
+    )
+    assert rules_of(findings) == ["RTL401"]
+
+
+def test_cleared_before_commit_flagged_and_fixed_form_ok():
+    findings = lint(
+        """
+        class Engine:
+            def bad(self, seq):
+                src, dst = seq.pending_copy
+                seq.pending_copy = None
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])
+
+            def good(self, seq):
+                src, dst = seq.pending_copy
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])
+                seq.pending_copy = None
+        """
+    )
+    assert rules_of(findings) == ["RTL403"]
+    assert findings[0].context.endswith("bad")
+
+
+def test_leaky_acquire_flagged_and_try_ok():
+    findings = lint(
+        """
+        class S:
+            def bad(self, n):
+                blocks = self.allocator.allocate(n)
+                self.compute(blocks)
+                self.allocator.free(blocks)
+
+            def good(self, n):
+                blocks = self.allocator.allocate(n)
+                try:
+                    self.compute(blocks)
+                finally:
+                    self.allocator.free(blocks)
+        """
+    )
+    rtl404 = [f for f in findings if f.rule == "RTL404"]
+    assert len(rtl404) == 1 and rtl404[0].context.endswith("bad")
+
+
+# ---------------------------------------------------------------------------
+# Suppressions + baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_reason_suppresses():
+    findings = lint(
+        """
+        def fire(handle):
+            # ray-tpu: lint-ignore[RTL401] metrics push is fire-and-forget
+            handle.ping.remote()
+        """
+    )
+    assert findings == []
+
+
+def test_suppression_inline_and_wildcard():
+    findings = lint(
+        """
+        def fire(handle):
+            handle.ping.remote()  # ray-tpu: lint-ignore[*] intentional
+        """
+    )
+    assert findings == []
+
+
+def test_suppression_without_reason_is_reported_not_honored():
+    findings = lint(
+        """
+        def fire(handle):
+            # ray-tpu: lint-ignore[RTL401]
+            handle.ping.remote()
+        """
+    )
+    assert sorted(rules_of(findings)) == ["RTL002", "RTL401"]
+
+
+def test_suppression_for_other_rule_does_not_mask():
+    findings = lint(
+        """
+        def fire(handle):
+            # ray-tpu: lint-ignore[RTL999] wrong id on purpose
+            handle.ping.remote()
+        """
+    )
+    assert "RTL401" in rules_of(findings)
+
+
+def test_stacked_standalone_suppressions_both_honored():
+    """Regression: two standalone lint-ignore comments above one statement
+    both resolve to that statement's line; the second used to overwrite
+    the first so neither finding stayed suppressed."""
+    findings = lint(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def fire(self, handle):
+                # ray-tpu: lint-ignore[RTL201] snapshot read is fine here
+                # ray-tpu: lint-ignore[RTL401] fire-and-forget by design
+                handle.ping.remote(self._n)
+        """
+    )
+    assert findings == []
+
+
+def test_skip_dirs_only_apply_below_scan_root(tmp_path):
+    """Regression: a checkout under a hidden/`build` ancestor used to be
+    skipped entirely, making the gate vacuously clean on 0 files."""
+    root = tmp_path / ".cache" / "build" / "proj"
+    pkg = root / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text("def fire(h):\n    h.ping.remote()\n")
+    (pkg / "__pycache__").mkdir()
+    (pkg / "__pycache__" / "mod.py").write_text("def fire(h):\n    h.ping.remote()\n")
+    (root / "pyproject.toml").write_text("[project]\nname='x'\n")
+
+    result = lint_paths([pkg], root=root)
+    assert result.files_scanned == 1  # __pycache__ below the root still skipped
+    assert rules_of(result.findings) == ["RTL401"]
+
+
+def test_suppression_covers_multiline_statement():
+    """Regression: a finding anchored to a continuation line of a
+    black-wrapped statement escaped the ignore comment above it (the
+    suppression mapped only to the statement's first line)."""
+    findings = lint(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def bump(self):
+                with self._lock:
+                    self._x += 1
+
+            def peek(self):
+                # ray-tpu: lint-ignore[RTL201] racy snapshot is fine here
+                return (
+                    self._x
+                    + 1
+                )
+
+            def also_bad(self):
+                return self._x
+        """
+    )
+    # The wrapped read is suppressed; the ignore must NOT leak past its
+    # statement to `also_bad`.
+    assert rules_of(findings) == ["RTL201"]
+    assert findings[0].context.endswith("also_bad")
+
+
+def test_suppression_on_compound_header_does_not_blanket_block():
+    findings = lint(
+        """
+        def fire(h, cond):
+            # ray-tpu: lint-ignore[RTL401] header-anchored, body must flag
+            if cond(
+                h
+            ):
+                h.ping.remote()
+        """
+    )
+    # The body finding is NOT suppressed — and the header-anchored ignore
+    # therefore protects nothing, which RTL003 reports as rot.
+    assert rules_of(findings) == ["RTL003", "RTL401"]
+
+
+def test_scoped_run_does_not_report_out_of_scope_baseline_stale(tmp_path):
+    """Regression: a path- or rule-scoped run used to report every
+    baseline entry it could not have re-produced as stale, telling users
+    to regenerate (and dashboards that the baseline rotted)."""
+    pkg = _write_pkg(tmp_path)  # mod.py: RTL302 + RTL401
+    full = lint_paths([pkg], root=tmp_path)
+    baseline = {
+        f.fingerprint: baseline_mod.entry_for(f, "triaged: fixture")
+        for f in full.findings
+    }
+
+    by_rule = lint_paths(
+        [pkg], rule_ids=["RTL302"], root=tmp_path, baseline=baseline
+    )
+    assert by_rule.stale_baseline == []
+
+    other = tmp_path / "other"
+    other.mkdir()
+    (other / "clean.py").write_text("x = 1\n")
+    by_path = lint_paths([other], root=tmp_path, baseline=baseline)
+    assert by_path.stale_baseline == []
+
+    # A genuinely-fixed finding in scope still reports stale.
+    (pkg / "mod.py").write_text("x = 1\n")
+    fixed = lint_paths([pkg], root=tmp_path, baseline=baseline)
+    assert len(fixed.stale_baseline) == 2
+
+
+def test_baseline_round_trip(tmp_path):
+    src = textwrap.dedent(
+        """
+        def fire(handle):
+            handle.ping.remote()
+        """
+    )
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+
+    result = lint_paths([pkg], root=tmp_path)
+    assert rules_of(result.findings) == ["RTL401"]
+
+    # Baseline it with a reason -> clean; entry survives line drift.
+    bl = tmp_path / baseline_mod.BASELINE_FILENAME
+    baseline_mod.save_baseline(
+        bl, [baseline_mod.entry_for(result.findings[0], "known fire-forget")]
+    )
+    baseline = baseline_mod.load_baseline(bl)
+    again = lint_paths([pkg], root=tmp_path, baseline=baseline)
+    assert again.findings == [] and len(again.baselined) == 1
+
+    (pkg / "mod.py").write_text("# a new comment line\n" + src)
+    drifted = lint_paths([pkg], root=tmp_path, baseline=baseline)
+    assert drifted.findings == [] and len(drifted.baselined) == 1
+
+    # Fixing the finding leaves a stale entry, reported as such.
+    (pkg / "mod.py").write_text("def fire(h):\n    return h.ping.remote()\n")
+    fixed = lint_paths([pkg], root=tmp_path, baseline=baseline)
+    assert fixed.findings == [] and fixed.stale_baseline
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json contract, --rule filter, exit codes
+# ---------------------------------------------------------------------------
+
+
+def _write_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import time\n\n"
+        "def t(fn):\n"
+        "    t0 = time.time()\n"
+        "    fn()\n"
+        "    return time.time() - t0\n\n"
+        "def fire(h):\n"
+        "    h.ping.remote()\n"
+    )
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    return pkg
+
+
+def test_cli_json_shape(tmp_path, capsys, monkeypatch):
+    pkg = _write_pkg(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(pkg), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["version"] == 1
+    assert report["files_scanned"] == 1
+    assert set(report["counts"]) == {
+        "active", "baselined", "suppressed", "parse_errors",
+        "stale_baseline", "untriaged_baseline",
+    }
+    assert report["counts"]["active"] == len(report["findings"]) == 2
+    finding = report["findings"][0]
+    assert set(finding) == {
+        "rule", "name", "family", "path", "line", "col", "context",
+        "message", "fingerprint",
+    }
+    assert {f["rule"] for f in report["findings"]} == {"RTL302", "RTL401"}
+
+
+def test_cli_rule_filter_and_exit_codes(tmp_path, capsys, monkeypatch):
+    pkg = _write_pkg(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(pkg), "--rule", "RTL401", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in report["findings"]} == {"RTL401"}
+    # Filtering to a rule with no findings -> exit 0.
+    assert lint_main([str(pkg), "--rule", "RTL102"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(tmp_path / "nope")]) == 2
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys, monkeypatch):
+    pkg = _write_pkg(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    assert lint_main([str(pkg), "--write-baseline"]) == 0
+    capsys.readouterr()
+    bl_path = tmp_path / baseline_mod.BASELINE_FILENAME
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 2
+    # TODO reasons gate: still exit 1 until a human writes reasons.
+    assert lint_main([str(pkg)]) == 1
+    capsys.readouterr()
+    for e in data["findings"]:
+        e["reason"] = "triaged: intentional in this fixture"
+    bl_path.write_text(json.dumps(data))
+    assert lint_main([str(pkg)]) == 0
+
+
+def test_overlapping_scan_paths_deduplicated(tmp_path):
+    """Regression: `lint pkg pkg/sub` used to scan sub's files twice —
+    the duplicate findings got occurrence-shifted fingerprints that no
+    longer matched the baseline, resurfacing grandfathered entries."""
+    pkg = _write_pkg(tmp_path)
+    result = lint_paths(
+        [tmp_path, pkg, pkg / "mod.py"], root=tmp_path
+    )
+    assert result.files_scanned == 1
+    assert len(result.findings) == 2
+
+    bl = [
+        baseline_mod.entry_for(f, "triaged: fixture")
+        for f in result.findings
+    ]
+    baseline = {e["fingerprint"]: e for e in bl}
+    again = lint_paths([tmp_path, pkg], root=tmp_path, baseline=baseline)
+    assert again.findings == [] and len(again.baselined) == 2
+
+
+def test_cli_lint_reachable_through_argparse_dispatch(capsys):
+    """Regression: `ray-tpu --num-cpus 2 lint ...` bypasses the argv[0]
+    fast-path intercept and used to die with KeyError('lint') in the
+    handler dict."""
+    from ray_tpu.scripts.cli import main as ray_tpu_main
+
+    rc = ray_tpu_main(
+        ["--num-cpus", "2", "lint", "--", "--list-rules"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "RTL201" in out
+    # And the fast path still owns bare `lint` flags.
+    assert ray_tpu_main(["lint", "--list-rules"]) == 0
+
+
+def test_write_baseline_scoped_run_preserves_out_of_scope(
+    tmp_path, capsys, monkeypatch
+):
+    """Regression: a --write-baseline scoped by path or --rule used to
+    treat every entry outside the scan as stale, deleting triaged
+    reasons; re-running also used to re-stamp written reasons with TODO."""
+    pkg_a = _write_pkg(tmp_path)  # RTL302 + RTL401
+    pkg_b = tmp_path / "other"
+    pkg_b.mkdir()
+    (pkg_b / "mod.py").write_text("def fire(h):\n    h.ping.remote()\n")
+    monkeypatch.chdir(tmp_path)
+    bl_path = tmp_path / baseline_mod.BASELINE_FILENAME
+
+    assert lint_main([str(pkg_a), str(pkg_b), "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 3
+    for e in data["findings"]:
+        e["reason"] = "triaged: intentional in this fixture"
+    bl_path.write_text(json.dumps(data))
+
+    # Path-scoped rewrite: pkg_b's entry and every written reason survive.
+    assert lint_main([str(pkg_a), "--write-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "0 new" in out
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 3
+    assert all(e["reason"].startswith("triaged") for e in data["findings"])
+
+    # Rule-scoped rewrite after fixing that rule's finding: only the
+    # in-scope stale entry drops.
+    (pkg_a / "mod.py").write_text(
+        "import time\n\ndef t(fn):\n    t0 = time.time()\n    fn()\n"
+        "    return time.time() - t0\n"
+    )
+    assert lint_main(
+        [str(pkg_a), str(pkg_b), "--rule", "RTL401", "--write-baseline"]
+    ) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert {e["rule"] for e in data["findings"]} == {"RTL302", "RTL401"}
+    assert len(data["findings"]) == 2  # pkg_a RTL401 dropped, RTL302 kept
+    assert lint_main([str(pkg_a), str(pkg_b)]) == 0
+
+
+def test_unused_suppression_flagged_only_on_full_runs():
+    """An orphaned reasoned lint-ignore (hazard fixed, or comment drifted
+    off the statement) is rot: RTL003 on full runs. A rule-scoped run
+    must stay silent — the other rules never had a chance to match it —
+    and a docstring SHOWING the idiom is string content, not a comment."""
+    src = """
+        def fire(h):
+            # ray-tpu: lint-ignore[RTL401] nothing below fires this rule
+            return h.value
+        """
+    assert rules_of(lint(src)) == ["RTL003"]
+
+    from ray_tpu.tools.lint.rules_resources import DroppedObjectRefRule
+
+    assert lint(src, rules=[DroppedObjectRefRule()]) == []
+
+    used = lint(
+        """
+        def fire(h):
+            # ray-tpu: lint-ignore[RTL401] fire-and-forget by design
+            h.ping.remote()
+        """
+    )
+    assert used == []
+
+    doc = lint(
+        '''
+        def helper():
+            """Suppress false positives like this:
+
+                x()  # ray-tpu: lint-ignore[RTL201] probe reads stale bool
+            """
+            return 1
+        '''
+    )
+    assert doc == []
+
+
+def test_cli_json_parse_errors_not_mixed_into_findings(
+    tmp_path, capsys, monkeypatch
+):
+    """Regression: --json used to append RTL001 parse errors into the
+    `findings` array while counts.active excluded them, so a consumer
+    gating on counts.active == 0 rendered 'clean' beside a non-empty
+    findings list."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(pkg), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["counts"]["active"] == len(report["findings"]) == 0
+    assert report["counts"]["parse_errors"] == 1
+    assert [e["rule"] for e in report["parse_errors"]] == ["RTL001"]
+
+
+def test_write_baseline_preserves_entries_of_unparseable_file(
+    tmp_path, capsys, monkeypatch
+):
+    """Regression: --write-baseline used to drop the triaged entries (and
+    their written reasons) of any file with a transient syntax error —
+    the file produced no findings, so its entries looked stale. Once the
+    file parsed again its findings came back active and broke the gate."""
+    pkg = _write_pkg(tmp_path)  # mod.py: RTL302 + RTL401
+    monkeypatch.chdir(tmp_path)
+    bl_path = tmp_path / baseline_mod.BASELINE_FILENAME
+
+    assert lint_main([str(pkg), "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 2
+    for e in data["findings"]:
+        e["reason"] = "triaged: intentional in this fixture"
+    bl_path.write_text(json.dumps(data))
+
+    good_source = (pkg / "mod.py").read_text()
+    (pkg / "mod.py").write_text(good_source + "def broken(:\n")
+    assert lint_main([str(pkg), "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 2
+    assert all(e["reason"].startswith("triaged") for e in data["findings"])
+
+    (pkg / "mod.py").write_text(good_source)
+    assert lint_main([str(pkg)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# The repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """`python -m ray_tpu.tools.lint ray_tpu/` must exit 0: every finding
+    on the tree is fixed, suppressed with a reason, or baselined with a
+    reason — and the scan fits the CI budget (<10s)."""
+    baseline = baseline_mod.load_baseline(
+        REPO_ROOT / baseline_mod.BASELINE_FILENAME
+    )
+    result = lint_paths(
+        [REPO_ROOT / "ray_tpu"], baseline=baseline, root=REPO_ROOT
+    )
+    assert result.parse_errors == []
+    assert result.findings == [], "\n".join(
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in result.findings
+    )
+    assert not result.stale_baseline, (
+        "stale baseline entries (regenerate with --write-baseline): "
+        f"{result.stale_baseline}"
+    )
+    assert baseline_mod.untriaged(baseline) == []
+    assert result.duration_s < 10.0
+    assert result.files_scanned > 150  # __pycache__/generated skipped
+
+
+def test_every_suppression_in_repo_has_reason():
+    """The inline-ignore idiom requires a reason everywhere in ray_tpu/."""
+    result = lint_paths(
+        [REPO_ROOT / "ray_tpu"],
+        rule_ids=["RTL002"],
+        baseline={},
+        root=REPO_ROOT,
+    )
+    assert result.findings == []

@@ -625,3 +625,50 @@ def test_llm_serve_deployment_end_to_end(llm_ray):
         )
     )
     assert [d["token_id"] for d in streamed] == res["token_ids"]
+
+
+def test_cow_copy_failure_releases_copy_source_ref():
+    """Regression (found by `ray-tpu lint` RTL403 cleared-before-commit):
+    a copy-on-write prefill whose device block copy raises must not leak
+    the extra ref admission took on the copy source. The engine used to
+    clear `pending_copy` BEFORE running the copy, so a poisoned CoW
+    request left the shared source block referenced forever — every such
+    failure permanently shrank the KV block pool."""
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=16, max_decode_slots=4, max_blocks_per_seq=8
+    )
+    eng = LLMEngine(TINY, ecfg, seed=0)
+    prompt = random_prompts((16,), seed=21)[0]  # exactly 2 full blocks
+
+    eng.add_request(prompt, max_new_tokens=2)
+    while eng.has_work():
+        eng.step()
+    assert eng.allocator.num_allocated == 0  # all parked evictable / free
+
+    # Same prompt again: fully cached admission takes the CoW path, and
+    # the injected failure hits exactly the device copy.
+    boom = RuntimeError("injected device copy failure")
+
+    def failing_copy(src, dst):
+        raise boom
+
+    original_copy = eng.runner.copy_block
+    eng.runner.copy_block = failing_copy
+    rid = eng.add_request(prompt, max_new_tokens=2)
+    try:
+        with pytest.raises(RuntimeError, match="injected device copy"):
+            eng.step()
+        # The step loop's poison-isolation path: attribute + dead-letter.
+        assert eng.culprit_for(boom) == rid
+        assert eng.fail_request(rid, boom)
+    finally:
+        eng.runner.copy_block = original_copy
+    # The copy-source ref must be gone: nothing allocated, engine idle.
+    assert eng.allocator.num_allocated == 0
+    assert not eng.has_work()
+    assert eng.dead_letters()[-1]["request_id"] == rid
+
+    # The pool still serves the same request afterwards (no shrinkage).
+    tokens = eng.generate([prompt], max_new_tokens=2)[0]
+    assert len(tokens) == 2
+    assert eng.allocator.num_allocated == 0

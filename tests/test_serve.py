@@ -766,3 +766,40 @@ def test_stream_cancel_releases_replica_slot(serve_instance):
     # the cancel completes the stream, the completion ref seals, and the
     # router releases the slot.
     assert handle.ping.remote().result(timeout_s=20) == "pong"
+
+
+def test_listen_for_change_timeout_immune_to_wallclock(monkeypatch):
+    """Regression (found by `ray-tpu lint` RTL302 wallclock-duration): the
+    controller long-poll deadline is monotonic. It used to be computed
+    from time.time(), so a frozen/backward-stepping wall clock made
+    `deadline - time.time()` never shrink and parked the poller (and the
+    actor thread serving it) indefinitely."""
+    from ray_tpu.serve._private.controller import ServeControllerActor
+
+    # Bare instance: just the fields listen_for_change touches, no
+    # reconcile thread (its wall-clock health probes are not under test).
+    ctrl = ServeControllerActor.__new__(ServeControllerActor)
+    ctrl._lock = threading.RLock()
+    ctrl._cv = threading.Condition(ctrl._lock)
+    ctrl._version = 0
+    ctrl._shutdown = False
+
+    frozen = time.time()
+    monkeypatch.setattr(time, "time", lambda: frozen)
+    done = threading.Event()
+    result = {}
+
+    def poll():
+        result["version"] = ctrl.listen_for_change(
+            known_version=5, timeout_s=0.3
+        )
+        done.set()
+
+    start = time.monotonic()
+    threading.Thread(target=poll, daemon=True).start()
+    assert done.wait(5.0), (
+        "listen_for_change hung on a frozen wall clock (deadline must be "
+        "monotonic)"
+    )
+    assert time.monotonic() - start < 4.0
+    assert result["version"] == 0
