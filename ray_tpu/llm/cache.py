@@ -5,6 +5,14 @@ device pools owned by the model runner. Block 0 is never handed out — it is
 the null block that pads block tables and absorbs masked-lane scatters, so
 a gather through an id of 0 is always safe (and always masked).
 
+Block ids are storage-format-agnostic: with `kv_cache_dtype="int8"` the
+runner keeps int8 pools plus per-token scale tensors addressed by the SAME
+block ids, and every device-side block operation (scatter, copy-on-write
+`copy_block`) moves values and scales together — so sharing, refcounts,
+eviction and CoW here need no notion of quantization. int8 halves the
+bytes per cached token, which doubles `num_blocks` for the same HBM: more
+sequences resident, fewer preemptions, better continuous batching.
+
 Automatic prefix caching (vLLM-style, restated for this allocator):
 
   * Every FULL block of a sequence gets a content key: the chain hash of

@@ -52,6 +52,26 @@ class EngineConfig:
     max_consecutive_step_failures: int = 3
     # How many dead-letter records (id, prompt hash, error) to retain.
     dead_letter_capacity: int = 64
+    # Paged-attention implementation for the decode / partial-prefill
+    # programs: "pallas" runs the fused block-table-walking kernel
+    # (ops.paged_flash — block gather, QK^T, masking, online softmax and
+    # weighted-V in one pass), "reference" the XLA gather+softmax op, and
+    # "auto" picks pallas on TPU, reference elsewhere. Greedy outputs are
+    # token-identical across implementations in the acceptance tests
+    # (f32, CPU interpret mode); on TPU in bf16 the two take different
+    # rounding paths (the kernel pre-scales q in storage dtype, the
+    # reference scales f32 logits), so near-tie argmax flips are
+    # possible, as with any kernel swap. Warmup compiles every bucket
+    # program with whichever implementation is selected.
+    attn_impl: str = "auto"
+    # KV-cache pool storage: "auto" follows the model dtype, "bf16"
+    # forces bfloat16, and "int8" stores quantized pools with per-token
+    # per-head scales (ops.paged_flash.quantize_kv) — roughly half the
+    # bytes per cached token, so ~1.9x the sequences fit the same pool
+    # and continuous batching keeps more requests in flight. Outputs are
+    # within quantization tolerance of bf16; greedy argmax is expected to
+    # match on typical prompts but is not bit-guaranteed.
+    kv_cache_dtype: str = "auto"
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-
@@ -92,6 +112,16 @@ class EngineConfig:
             raise ValueError("dead_letter_capacity must be >= 1")
         if self.flight_recorder_capacity < 1:
             raise ValueError("flight_recorder_capacity must be >= 1")
+        if self.attn_impl not in ("auto", "pallas", "reference"):
+            raise ValueError(
+                "attn_impl must be one of ('auto', 'pallas', 'reference'), "
+                f"got {self.attn_impl!r}"
+            )
+        if self.kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(
+                "kv_cache_dtype must be one of ('auto', 'bf16', 'int8'), "
+                f"got {self.kv_cache_dtype!r}"
+            )
         from ray_tpu.llm.cache import EVICTION_POLICIES
 
         if self.prefix_eviction_policy not in EVICTION_POLICIES:

@@ -176,13 +176,27 @@ class LLMEngine:
             "One engine phase dispatch (prefill per sequence, decode per "
             "batched step)",
             boundaries=STEP_SECONDS_BOUNDARIES,
-            tag_keys=("engine", "phase"),
+            tag_keys=("engine", "phase", "attn_impl"),
         )
-        # Pre-merged tag dicts so the step loop never builds dicts.
+        # Which paged-attention implementation the runner resolved (pallas
+        # fused kernel vs XLA reference): tagged onto the step histograms
+        # and per-step flight records so the observability plane can
+        # attribute a speedup (or regression) to the kernel in production.
+        self._attn_impl = self.runner.attn_impl
+        # Pre-merged tag dicts so the step loop never builds dicts. Full
+        # prefill runs model.apply with no paged caches — the knob cannot
+        # affect it — so its series is tagged "n/a" rather than letting
+        # unrelated latency differences read as kernel effects; only the
+        # partial-prefill and decode programs dispatch on attn_impl.
         self._step_tags = {
-            "prefill": {**self._metric_tags, "phase": "prefill"},
-            "partial_prefill": {**self._metric_tags, "phase": "partial_prefill"},
-            "decode": {**self._metric_tags, "phase": "decode"},
+            phase: {
+                **self._metric_tags,
+                "phase": phase,
+                "attn_impl": (
+                    "n/a" if phase == "prefill" else self._attn_impl
+                ),
+            }
+            for phase in ("prefill", "partial_prefill", "decode")
         }
         # Observability plane (EngineConfig.instrument): per-request phase
         # spans + the per-step flight-recorder ring. The recorder object
@@ -466,6 +480,7 @@ class LLMEngine:
                 {
                     "step": self._steps - 1,
                     "phase": phase,
+                    "attn_impl": self._attn_impl,
                     "batch_size": len(decoding),
                     "num_prefills": len(admitted),
                     "prefills": prefill_info,
@@ -645,6 +660,8 @@ class LLMEngine:
         elapsed = max(time.monotonic() - self._start, 1e-9)
         return {
             "engine_id": self._metric_tags["engine"],
+            "attn_impl": self._attn_impl,
+            "kv_cache_dtype": self.runner.kv_cache_dtype_str,
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
             "mean_occupancy": (

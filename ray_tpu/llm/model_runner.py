@@ -9,12 +9,26 @@ suffix), and one block-to-block copy (copy-on-write for shared blocks).
 The cache pools are [L, num_blocks, block_size, H, D] device arrays
 threaded functionally through every step with donated buffers, so steps
 update the cache in place without host round-trips.
+
+Serving hot-path knobs (EngineConfig):
+
+  * ``attn_impl`` — the decode / partial-prefill programs read the cache
+    either through the fused Pallas kernel (``ops.paged_flash``: the block
+    table is walked inside the kernel pipeline, gather + QK^T + masking +
+    online softmax + weighted-V in one pass) or the XLA gather+softmax
+    reference. "auto" resolves once at construction: pallas on TPU,
+    reference elsewhere. Warmup compiles every bucket program with whatever
+    was resolved, so the kernel never cold-compiles under live traffic.
+  * ``kv_cache_dtype`` — "int8" stores the pools quantized with per-token
+    per-head scale tensors [L, N, bs, H] (scales ride every scatter and
+    block copy); dequantization is fused into the attention op. ~1.9x the
+    sequences fit the same pool bytes.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +36,11 @@ import numpy as np
 
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.models.gpt import GPT, GPTConfig, collect_kv_caches
+from ray_tpu.ops.paged_flash import (
+    KV_SCALE_DTYPE,
+    quantize_kv,
+    resolve_paged_impl,
+)
 
 
 class GPTRunner:
@@ -47,6 +66,21 @@ class GPTRunner:
             params = self.model.init(jax.random.PRNGKey(seed), probe)
         self.params = params
 
+        # Resolved once: the jitted programs below bake the choice in.
+        self.attn_impl = resolve_paged_impl(engine_config.attn_impl)
+        self.kv_cache_dtype = {
+            "auto": model_config.dtype,
+            "bf16": jnp.bfloat16,
+            "int8": jnp.int8,
+        }[engine_config.kv_cache_dtype]
+        self.quantized = self.kv_cache_dtype == jnp.int8
+        # What the pools actually store, in the knob's vocabulary —
+        # observability reports this, not the configured string, so
+        # "auto" never leaks to dashboards.
+        self.kv_cache_dtype_str = {
+            jnp.bfloat16: "bf16", jnp.int8: "int8"
+        }.get(self.kv_cache_dtype, jnp.dtype(self.kv_cache_dtype).name)
+
         cfg, ecfg = model_config, engine_config
         cache_shape = (
             cfg.num_layers,
@@ -55,22 +89,58 @@ class GPTRunner:
             cfg.num_heads,
             cfg.head_dim,
         )
-        self.k_cache = jnp.zeros(cache_shape, cfg.dtype)
-        self.v_cache = jnp.zeros(cache_shape, cfg.dtype)
-        self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1, 2))
-        self._prefill_fn = jax.jit(self._prefill_step, donate_argnums=(1, 2))
+        self.k_cache = jnp.zeros(cache_shape, self.kv_cache_dtype)
+        self.v_cache = jnp.zeros(cache_shape, self.kv_cache_dtype)
+        if self.quantized:
+            scale_shape = cache_shape[:-1]  # [L, N, bs, H]
+            self.k_scale = jnp.zeros(scale_shape, KV_SCALE_DTYPE)
+            self.v_scale = jnp.zeros(scale_shape, KV_SCALE_DTYPE)
+        else:
+            self.k_scale = None
+            self.v_scale = None
+        self._decode_fn = jax.jit(
+            self._decode_step, donate_argnums=(1, 2, 3, 4)
+        )
+        self._prefill_fn = jax.jit(
+            self._prefill_step, donate_argnums=(1, 2, 3, 4)
+        )
         self._prefill_suffix_fn = jax.jit(
-            self._prefill_suffix_step, donate_argnums=(1, 2)
+            self._prefill_suffix_step, donate_argnums=(1, 2, 3, 4)
         )
         self._copy_block_fn = jax.jit(
-            self._copy_block_step, donate_argnums=(0, 1)
+            self._copy_block_step, donate_argnums=(0, 1, 2, 3)
         )
+
+    # ---------------- pool plumbing ----------------
+
+    @property
+    def _pools(self):
+        return (self.k_cache, self.v_cache, self.k_scale, self.v_scale)
+
+    def _set_pools(self, pools) -> None:
+        self.k_cache, self.v_cache, self.k_scale, self.v_scale = pools
+
+    def _paged_caches(self, k_cache, v_cache, k_scale, v_scale,
+                      block_tables, context_lens):
+        return (k_cache, v_cache, block_tables, context_lens, k_scale,
+                v_scale)
+
+    def _store_kv(self, new_kv: jax.Array) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """New-token K or V [..., H, D] → (pool-dtype values, per-token
+        scales or None). int8 pools quantize at scatter time — per-token
+        scales are what a single-token decode write can maintain."""
+        if self.quantized:
+            return quantize_kv(new_kv)
+        return new_kv.astype(self.kv_cache_dtype), None
 
     # ---------------- prefill ----------------
 
-    def _prefill_step(self, params, k_cache, v_cache, tokens, blocks, true_len):
+    def _prefill_step(
+        self, params, k_cache, v_cache, k_scale, v_scale, tokens, blocks,
+        true_len,
+    ):
         """tokens [1, S_bucket], blocks [S_bucket // bs] (0-padded),
-        true_len scalar → (k_cache, v_cache, next_token)."""
+        true_len scalar → (pools, next_token)."""
         cfg, ecfg = self.model_config, self.engine_config
         logits, state = self.model.apply(
             params, tokens, return_kv=True, mutable=["intermediates"]
@@ -78,16 +148,21 @@ class GPTRunner:
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
         s = tokens.shape[1]
         nb = s // ecfg.block_size
+        paged = (nb, ecfg.block_size, cfg.num_heads, cfg.head_dim)
         for layer, (k, v) in enumerate(kvs):
-            paged = (nb, ecfg.block_size, cfg.num_heads, cfg.head_dim)
-            k_cache = k_cache.at[layer, blocks].set(
-                k[0].reshape(paged).astype(k_cache.dtype)
-            )
-            v_cache = v_cache.at[layer, blocks].set(
-                v[0].reshape(paged).astype(v_cache.dtype)
-            )
+            kq, ks = self._store_kv(k[0])
+            vq, vs = self._store_kv(v[0])
+            k_cache = k_cache.at[layer, blocks].set(kq.reshape(paged))
+            v_cache = v_cache.at[layer, blocks].set(vq.reshape(paged))
+            if ks is not None:
+                k_scale = k_scale.at[layer, blocks].set(
+                    ks.reshape(paged[:-1])
+                )
+                v_scale = v_scale.at[layer, blocks].set(
+                    vs.reshape(paged[:-1])
+                )
         next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
-        return k_cache, v_cache, next_token
+        return (k_cache, v_cache, k_scale, v_scale), next_token
 
     def prefill(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
         """Run one prompt through the model, scatter its K/V into the given
@@ -102,25 +177,26 @@ class GPTRunner:
         # null block; it is garbage that nothing ever reads unmasked.
         blocks = np.zeros((nb,), np.int32)
         blocks[: len(block_ids)] = block_ids
-        self.k_cache, self.v_cache, next_token = self._prefill_fn(
+        pools, next_token = self._prefill_fn(
             self.params,
-            self.k_cache,
-            self.v_cache,
+            *self._pools,
             jnp.asarray(tokens),
             jnp.asarray(blocks),
             jnp.int32(n),
         )
+        self._set_pools(pools)
         return int(next_token)
 
     # ---------------- partial prefill (prefix caching) ----------------
 
     def _prefill_suffix_step(
-        self, params, k_cache, v_cache, tokens, block_table, offset, true_len
+        self, params, k_cache, v_cache, k_scale, v_scale, tokens,
+        block_table, offset, true_len,
     ):
         """tokens [1, S_bucket] uncached suffix (0-padded), block_table
         [max_blocks_per_seq] the sequence's full table (0-padded), offset
         scalar = cached prefix length, true_len scalar = real suffix length
-        → (k_cache, v_cache, next_token).
+        → (pools, next_token).
 
         One program per suffix bucket: the suffix attends to the cached
         prefix through the block table (paged) and to itself causally, and
@@ -135,12 +211,11 @@ class GPTRunner:
             params,
             tokens,
             positions=positions[None, :],
-            paged_caches=(
-                k_cache,
-                v_cache,
-                block_table[None, :],
-                jnp.reshape(offset, (1,)),
+            paged_caches=self._paged_caches(
+                k_cache, v_cache, k_scale, v_scale,
+                block_table[None, :], jnp.reshape(offset, (1,)),
             ),
+            paged_impl=self.attn_impl,
             mutable=["intermediates"],
         )
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
@@ -148,14 +223,15 @@ class GPTRunner:
         block_ids = jnp.where(valid, block_table[positions // bs], 0)
         offsets = jnp.where(valid, positions % bs, 0)
         for layer, (k, v) in enumerate(kvs):
-            k_cache = k_cache.at[layer, block_ids, offsets].set(
-                k[0].astype(k_cache.dtype)
-            )
-            v_cache = v_cache.at[layer, block_ids, offsets].set(
-                v[0].astype(v_cache.dtype)
-            )
+            kq, ks = self._store_kv(k[0])
+            vq, vs = self._store_kv(v[0])
+            k_cache = k_cache.at[layer, block_ids, offsets].set(kq)
+            v_cache = v_cache.at[layer, block_ids, offsets].set(vq)
+            if ks is not None:
+                k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
+                v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
-        return k_cache, v_cache, next_token
+        return (k_cache, v_cache, k_scale, v_scale), next_token
 
     def prefill_suffix(
         self, token_ids: Sequence[int], block_ids: Sequence[int], offset: int
@@ -171,38 +247,42 @@ class GPTRunner:
         tokens[0, :n] = token_ids
         table = np.zeros((ecfg.max_blocks_per_seq,), np.int32)
         table[: len(block_ids)] = block_ids
-        self.k_cache, self.v_cache, next_token = self._prefill_suffix_fn(
+        pools, next_token = self._prefill_suffix_fn(
             self.params,
-            self.k_cache,
-            self.v_cache,
+            *self._pools,
             jnp.asarray(tokens),
             jnp.asarray(table),
             jnp.int32(offset),
             jnp.int32(n),
         )
+        self._set_pools(pools)
         return int(next_token)
 
-    def _copy_block_step(self, k_cache, v_cache, src, dst):
+    def _copy_block_step(self, k_cache, v_cache, k_scale, v_scale, src, dst):
         k_cache = k_cache.at[:, dst].set(k_cache[:, src])
         v_cache = v_cache.at[:, dst].set(v_cache[:, src])
-        return k_cache, v_cache
+        if k_scale is not None:
+            # int8 pools: a block copy must carry the dequant scales too,
+            # or the CoW copy would be read back at the wrong magnitude.
+            k_scale = k_scale.at[:, dst].set(k_scale[:, src])
+            v_scale = v_scale.at[:, dst].set(v_scale[:, src])
+        return k_cache, v_cache, k_scale, v_scale
 
     def copy_block(self, src: int, dst: int) -> None:
-        """Device-copy one block's K/V across every layer (copy-on-write
-        before a sequence writes into a block it shares)."""
-        self.k_cache, self.v_cache = self._copy_block_fn(
-            self.k_cache, self.v_cache, jnp.int32(src), jnp.int32(dst)
+        """Device-copy one block's K/V (and scales) across every layer
+        (copy-on-write before a sequence writes into a shared block)."""
+        self._set_pools(
+            self._copy_block_fn(*self._pools, jnp.int32(src), jnp.int32(dst))
         )
 
     # ---------------- decode ----------------
 
     def _decode_step(
-        self, params, k_cache, v_cache, tokens, positions, block_tables,
-        context_lens,
+        self, params, k_cache, v_cache, k_scale, v_scale, tokens, positions,
+        block_tables, context_lens,
     ):
         """One iteration-level decode over all slots. tokens/positions [B],
-        block_tables [B, nb], context_lens [B] → (k_cache, v_cache,
-        next_tokens [B])."""
+        block_tables [B, nb], context_lens [B] → (pools, next_tokens [B])."""
         cfg = self.model_config
         bs = self.engine_config.block_size
         b = tokens.shape[0]
@@ -210,7 +290,10 @@ class GPTRunner:
             params,
             tokens[:, None],
             positions=positions[:, None],
-            paged_caches=(k_cache, v_cache, block_tables, context_lens),
+            paged_caches=self._paged_caches(
+                k_cache, v_cache, k_scale, v_scale, block_tables, context_lens
+            ),
+            paged_impl=self.attn_impl,
             mutable=["intermediates"],
         )
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
@@ -219,14 +302,15 @@ class GPTRunner:
         block_ids = block_tables[jnp.arange(b), positions // bs]
         offsets = positions % bs
         for layer, (k, v) in enumerate(kvs):
-            k_cache = k_cache.at[layer, block_ids, offsets].set(
-                k[:, 0].astype(k_cache.dtype)
-            )
-            v_cache = v_cache.at[layer, block_ids, offsets].set(
-                v[:, 0].astype(v_cache.dtype)
-            )
+            kq, ks = self._store_kv(k[:, 0])
+            vq, vs = self._store_kv(v[:, 0])
+            k_cache = k_cache.at[layer, block_ids, offsets].set(kq)
+            v_cache = v_cache.at[layer, block_ids, offsets].set(vq)
+            if ks is not None:
+                k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
+                v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         next_tokens = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-        return k_cache, v_cache, next_tokens
+        return (k_cache, v_cache, k_scale, v_scale), next_tokens
 
     def decode(
         self,
@@ -237,13 +321,13 @@ class GPTRunner:
     ) -> np.ndarray:
         """Batched single-token decode; arrays must already be padded to
         [max_decode_slots] / [max_decode_slots, max_blocks_per_seq]."""
-        self.k_cache, self.v_cache, next_tokens = self._decode_fn(
+        pools, next_tokens = self._decode_fn(
             self.params,
-            self.k_cache,
-            self.v_cache,
+            *self._pools,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(block_tables, jnp.int32),
             jnp.asarray(context_lens, jnp.int32),
         )
+        self._set_pools(pools)
         return np.asarray(next_tokens)

@@ -21,8 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops import attention as attention_op
-from ray_tpu.ops import paged_attention
 from ray_tpu.ops.flash_attention import flash_attention_packed
+from ray_tpu.ops.paged_flash import paged_attention_impl
 from ray_tpu.ops.ring_attention import ring_attention
 
 
@@ -90,6 +90,7 @@ class Block(nn.Module):
         *,
         return_kv: bool = False,
         paged_state: Optional[tuple] = None,
+        paged_impl: str = "reference",
     ):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
@@ -107,10 +108,18 @@ class Block(nn.Module):
             k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
             v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
             if paged_state is not None:
-                k_cache_l, v_cache_l, block_tables, context_lens = paged_state
-                attn = paged_attention(
+                (k_cache_l, v_cache_l, block_tables, context_lens,
+                 k_scale_l, v_scale_l) = paged_state
+                # "pallas" runs the fused kernel (walks the block table
+                # inside the pipeline, never materializing the gathered
+                # pages or the logits — ops/paged_flash.py); "reference"
+                # the XLA gather+softmax op. The engine resolves "auto"
+                # before tracing, so the choice is compile-time static.
+                attn = paged_attention_impl(
                     q, k_cache_l, v_cache_l, block_tables, context_lens,
                     new_k=k, new_v=v,
+                    k_scale=k_scale_l, v_scale=v_scale_l,
+                    impl=paged_impl,
                 )
             else:
                 impl = (
@@ -176,6 +185,7 @@ class GPT(nn.Module):
         positions: Optional[jax.Array] = None,
         return_kv: bool = False,
         paged_caches: Optional[tuple] = None,
+        paged_impl: str = "reference",
     ):
         """Forward pass.
 
@@ -184,14 +194,17 @@ class GPT(nn.Module):
             ``mutable=["intermediates"]`` and read each layer's prompt K/V
             back via :func:`collect_kv_caches`.
           * ``paged_caches=(k_cache, v_cache, block_tables, context_lens)``
-            (decode and prefix-aware partial prefill): k/v_cache are
-            [L, num_blocks, block_size, H, D] paged pools; tokens is [B, S]
-            (S == 1 for decode, S > 1 for the uncached suffix of a
-            partially-cached prompt) and ``positions`` [B, S] must carry
-            each token's absolute position. Attention reads the cached
-            prefix through the block table and runs causally over the fed
-            tokens (ops.paged_attention); the new K/V is sown for the
-            caller to scatter into the cache.
+            or ``(..., k_scale, v_scale)`` (decode and prefix-aware partial
+            prefill): k/v_cache are [L, num_blocks, block_size, H, D] paged
+            pools (int8 pools carry [L, N, bs, H] scale tensors; pass None
+            scales otherwise); tokens is [B, S] (S == 1 for decode, S > 1
+            for the uncached suffix of a partially-cached prompt) and
+            ``positions`` [B, S] must carry each token's absolute position.
+            Attention reads the cached prefix through the block table and
+            runs causally over the fed tokens — through the fused Pallas
+            kernel when ``paged_impl="pallas"``, the XLA reference
+            otherwise; the new K/V is sown for the caller to scatter into
+            the cache.
         """
         cfg = self.config
         b, s = tokens.shape
@@ -216,21 +229,28 @@ class GPT(nn.Module):
         if positions is None:
             positions = jnp.arange(s)[None, :]
         x = wte(tokens) + wpe(positions)
+        if paged_caches is not None:
+            if len(paged_caches) == 4:  # legacy: no scale tensors
+                paged_caches = tuple(paged_caches) + (None, None)
+            (k_cache, v_cache, block_tables, context_lens,
+             k_scale, v_scale) = paged_caches
         for i in range(cfg.num_layers):
             use_moe = bool(
                 cfg.num_experts and (i % cfg.moe_every == cfg.moe_every - 1)
             )
             paged_state = None
             if paged_caches is not None:
-                k_cache, v_cache, block_tables, context_lens = paged_caches
                 paged_state = (
-                    k_cache[i], v_cache[i], block_tables, context_lens
+                    k_cache[i], v_cache[i], block_tables, context_lens,
+                    None if k_scale is None else k_scale[i],
+                    None if v_scale is None else v_scale[i],
                 )
             x = Block(cfg, use_moe=use_moe, name=f"h_{i}")(
                 x,
                 deterministic=deterministic,
                 return_kv=return_kv,
                 paged_state=paged_state,
+                paged_impl=paged_impl,
             )
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Tied LM head: logits via the embedding matrix. The matmul runs in

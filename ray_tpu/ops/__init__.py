@@ -1,12 +1,22 @@
 from ray_tpu.ops.attention import mha_reference, paged_attention
 from ray_tpu.ops.flash_attention import attention, flash_attention
+from ray_tpu.ops.paged_flash import (
+    dequantize_kv,
+    paged_attention_impl,
+    paged_flash_attention,
+    quantize_kv,
+)
 from ray_tpu.ops.ring_attention import ring_attention, ring_self_attention
 
 __all__ = [
     "attention",
+    "dequantize_kv",
     "flash_attention",
     "mha_reference",
     "paged_attention",
+    "paged_attention_impl",
+    "paged_flash_attention",
+    "quantize_kv",
     "ring_attention",
     "ring_self_attention",
 ]

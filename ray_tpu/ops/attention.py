@@ -42,6 +42,34 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
+def validate_kv_scales(k_cache, v_cache, k_scale, v_scale) -> None:
+    """One shared contract for both paged-attention implementations, so
+    impl='auto' can never accept inputs on one backend that the other
+    rejects: pools must share a dtype, int8 pools require BOTH dequant
+    scales, and scales require int8 pools (silently dropping or applying
+    them would diverge)."""
+    if k_cache.dtype != v_cache.dtype:
+        raise ValueError(
+            f"k_cache/v_cache dtypes differ ({k_cache.dtype} vs "
+            f"{v_cache.dtype}); the pools must share one storage dtype"
+        )
+    if (k_scale is not None or v_scale is not None) and k_cache.dtype != jnp.int8:
+        raise ValueError(
+            f"k_scale/v_scale passed with non-int8 cache pools "
+            f"({k_cache.dtype}): dequant scales only apply to int8 pools"
+        )
+    if k_cache.dtype == jnp.int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 k_cache/v_cache require k_scale/v_scale")
+
+
+def dequantize_kv(values: jax.Array, scales: jax.Array) -> jax.Array:
+    """Inverse of ops.paged_flash.quantize_kv, in f32: values [..., H, D]
+    * scales [..., H]. Lives here, next to the shared scale contract, so
+    the reference op and the fused kernel's tests share ONE definition of
+    the quantization semantics."""
+    return values.astype(jnp.float32) * scales.astype(jnp.float32)[..., None]
+
+
 def paged_attention(
     q: jax.Array,
     k_cache: jax.Array,
@@ -52,6 +80,8 @@ def paged_attention(
     new_k: Optional[jax.Array] = None,
     new_v: Optional[jax.Array] = None,
     sm_scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention over the paged KV cache through per-sequence block tables.
 
@@ -75,6 +105,14 @@ def paged_attention(
     new_k/new_v:  [B, S, H, D] the new tokens' K/V. They have not been
                   scattered into the cache yet, so they ride along as extra
                   always-gathered slots under a causal (j <= i) mask.
+    k_scale/v_scale: [N, bs, H] per-token dequant scales for int8 cache
+                  pools (ops.paged_flash.quantize_kv); the gathered pages
+                  are dequantized in f32 before use, making this op the
+                  exact oracle for the fused kernel's int8 path.
+
+    Fully-masked rows (a padded slot with context_len 0 and no new
+    tokens) return exact zeros rather than a uniform average of garbage
+    gathered through the null block.
 
     Returns [B, S, H, D].
     """
@@ -83,9 +121,18 @@ def paged_attention(
     bs = k_cache.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    validate_kv_scales(k_cache, v_cache, k_scale, v_scale)
     # Gather the pages: [B, nb, bs, H, D] -> [B, nb*bs, H, D].
     k_ctx = k_cache[block_tables].reshape(b, nb * bs, h, d)
     v_ctx = v_cache[block_tables].reshape(b, nb * bs, h, d)
+    if k_scale is not None:
+        k_ctx = dequantize_kv(
+            k_ctx, k_scale[block_tables].reshape(b, nb * bs, h)
+        ).astype(q.dtype)
+    if v_scale is not None:
+        v_ctx = dequantize_kv(
+            v_ctx, v_scale[block_tables].reshape(b, nb * bs, h)
+        ).astype(q.dtype)
     # [B, Q, K] mask: every query sees every valid cached position.
     valid = jnp.broadcast_to(
         (jnp.arange(nb * bs)[None, :] < context_lens[:, None])[:, None, :],
@@ -105,6 +152,15 @@ def paged_attention(
     logits = logits * sm_scale
     logits = jnp.where(valid[:, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if new_k is None or new_k.shape[1] < q_len:
+        # Softmax over an all-NEG_INF row degrades to uniform weights over
+        # whatever the null block holds; masked/empty slots must contribute
+        # exact zeros instead (the finalize_partial l == 0 hygiene). With
+        # new tokens riding along at s_new >= q_len — every engine step —
+        # the causal diagonal guarantees each query at least one valid
+        # key, so this pass is statically skipped on the hot path.
+        any_valid = jnp.any(valid, axis=-1)  # [B, Q]
+        weights = weights * any_valid[:, None, :, None]
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_ctx.dtype), v_ctx)
     return out.astype(q.dtype)
 

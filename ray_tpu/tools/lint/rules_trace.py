@@ -10,6 +10,12 @@ inside a jitted function silently produce wrong-but-fast programs
 RTL303: mutation of closed-over / self state inside a jitted function —
 same trace-once hazard for state instead of values.
 
+Both rules also cover Pallas kernel bodies (`pl.pallas_call(kernel, ...)`,
+including `functools.partial(kernel, ...)` forms): a kernel is traced
+exactly like a jitted function, so host side effects and closure mutation
+inside it are the same silent trace-time-only bugs. Ref/scratch writes
+(`o_ref[...] = x`) are writes to kernel *arguments* and are never flagged.
+
 RTL302: durations or deadlines computed from `time.time()`. Wall clock
 steps under NTP/suspend, so `deadline = time.time() + t` can hang or
 fire early; `time.time() - t0` durations jitter. Use
@@ -25,7 +31,7 @@ from typing import Dict, List, Optional, Set
 
 from ray_tpu.tools.lint.core import Finding, ModuleInfo, Rule
 
-JIT_WRAPPER_SUFFIXES = ("jit", "pjit", "pmap", "shard_map")
+JIT_WRAPPER_SUFFIXES = ("jit", "pjit", "pmap", "shard_map", "pallas_call")
 
 IMPURE_CALL_PREFIXES = (
     "time.",
@@ -50,7 +56,7 @@ def _is_jit_wrapper(module: ModuleInfo, func: ast.AST) -> bool:
     last = dotted.rsplit(".", 1)[-1]
     if last not in JIT_WRAPPER_SUFFIXES:
         return False
-    if last in ("pjit", "shard_map", "pmap"):
+    if last in ("pjit", "shard_map", "pmap", "pallas_call"):
         return True
     # Bare `jit`: require a jax-ish origin so `obj.jit` elsewhere (or a
     # local helper named jit) doesn't fire.
@@ -70,14 +76,76 @@ def _jitted_function_args(module: ModuleInfo, call: ast.Call):
     return out
 
 
-def _resolve_function(module: ModuleInfo, expr: ast.AST, at: ast.AST):
+def _target_binds(target: ast.AST, name: str) -> bool:
+    """Does an assignment-like target bind `name`? Sees through tuple /
+    list unpacking and starred elements."""
+    if isinstance(target, ast.Name):
+        return target.id == name
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_target_binds(el, name) for el in target.elts)
+    if isinstance(target, ast.Starred):
+        return _target_binds(target.value, name)
+    return False
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg is not None:
+        names.add(a.vararg.arg)
+    if a.kwarg is not None:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _scope_level_nodes(scope: ast.AST):
+    """Nodes lexically inside `scope` without descending into nested
+    scopes — a function/class body introduces its own namespace, so its
+    bindings are not visible where `scope`'s are."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _resolve_function(
+    module: ModuleInfo, expr: ast.AST, at: ast.AST, _depth: int = 0
+):
     """Map a function expression to a FunctionDef/Lambda defined in this
     module: a bare name (module function or sibling nested def), a
-    `self._method`, or an inline lambda. None when not resolvable."""
+    `self._method`, or an inline lambda. Sees through
+    `functools.partial(fn, ...)` — inline, or bound to a local name first
+    (`kernel = functools.partial(fn, ...)`), the two ways Pallas kernels
+    are handed to pallas_call. None when not resolvable."""
+    if _depth > 8:  # self-referential bindings (f = partial(f, ...))
+        return None
     if isinstance(expr, ast.Lambda):
         return expr
+    if isinstance(expr, ast.Call):
+        dotted = module.dotted_name(expr.func)
+        if (
+            dotted is not None
+            and dotted.rsplit(".", 1)[-1] == "partial"
+            and expr.args
+        ):
+            return _resolve_function(module, expr.args[0], at, _depth + 1)
+        return None
     if isinstance(expr, ast.Name):
-        # Nearest definition in the lexical scope chain of `at`.
+        # Nearest binding in the lexical scope chain of `at`: innermost
+        # scope first, and within a scope the LATEST binding (def or
+        # assignment) wins — a local `kernel = functools.partial(...)`
+        # rebinding shadows an earlier def, exactly as at runtime. Up to
+        # the enclosing function boundary statements execute in lineno
+        # order, so bindings AFTER the use site are not yet live and are
+        # ignored; past that boundary (outer scopes run before the inner
+        # function is called) any binding counts. A local binding we
+        # can't resolve stops the walk: outer scopes are shadowed, so
+        # analyzing them would blame the wrong function.
         scope = module.parent(at)
         chain = []
         while scope is not None:
@@ -85,12 +153,77 @@ def _resolve_function(module: ModuleInfo, expr: ast.AST, at: ast.AST):
             scope = module.parent(scope)
         if not chain or chain[-1] is not module.tree:
             chain.append(module.tree)
+        sequential = True  # still inside the function body holding `at`
+        crossed_function = False
         for scope in chain:
-            for node in ast.walk(scope):
+            if isinstance(scope, ast.ClassDef) and crossed_function:
+                # Python name resolution skips class scope from inside
+                # methods: a sibling method or class attr named like the
+                # target is NOT what the bare name resolves to there.
+                continue
+            best = None  # latest live binding of the name in this scope
+            for node in _scope_level_nodes(scope):
+                bind = None
                 if isinstance(
                     node, (ast.FunctionDef, ast.AsyncFunctionDef)
                 ) and node.name == expr.id:
-                    return node
+                    bind = node
+                elif isinstance(node, ast.Assign) and any(
+                    _target_binds(t, expr.id) for t in node.targets
+                ):
+                    bind = node
+                elif isinstance(
+                    node, (ast.AnnAssign, ast.NamedExpr)
+                ) and _target_binds(node.target, expr.id):
+                    bind = node
+                elif isinstance(
+                    node, (ast.For, ast.AsyncFor)
+                ) and _target_binds(node.target, expr.id):
+                    bind = node
+                elif isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                    item.optional_vars is not None
+                    and _target_binds(item.optional_vars, expr.id)
+                    for item in node.items
+                ):
+                    bind = node
+                if bind is not None and sequential and (
+                    bind.lineno > getattr(at, "lineno", bind.lineno)
+                ):
+                    bind = None  # not yet executed where the call runs
+                if bind is not None and (
+                    best is None or bind.lineno > best.lineno
+                ):
+                    best = bind
+            if isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                sequential = False
+                crossed_function = True
+                if best is None and expr.id in _param_names(scope):
+                    # Bound by a parameter: the traced function is
+                    # whatever the caller passes — opaque, and it shadows
+                    # any same-named outer def. Stop, don't misattribute.
+                    return None
+            if best is None:
+                continue
+            if isinstance(best, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return best
+            # Some assignment-like form binds the name in this scope:
+            # resolve its value where one maps to the name directly, else
+            # give up — walking outward would analyze a shadowed,
+            # never-traced binding (tuple unpacking, for/with targets, a
+            # bare `kernel: Callable` annotation are all opaque).
+            if isinstance(best, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in best.targets
+            ):
+                return _resolve_function(module, best.value, at, _depth + 1)
+            if (
+                isinstance(best, (ast.AnnAssign, ast.NamedExpr))
+                and best.value is not None
+            ):
+                return _resolve_function(module, best.value, at, _depth + 1)
+            return None
         return None
     if (
         isinstance(expr, ast.Attribute)

@@ -421,6 +421,293 @@ def test_shard_map_and_nested_def():
     assert "RTL301" in rules_of(findings)
 
 
+def test_pallas_call_body_impurity_flagged():
+    """RTL301 trace-safety applies inside Pallas kernels too: a kernel body
+    is traced exactly once, so host clocks/prints inside it are baked-in
+    constants — including kernels handed to pallas_call via
+    functools.partial, the idiom every ops/ kernel uses."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            t = time.time()
+            o_ref[:] = x_ref[:] * t
+
+        def call(x):
+            return pl.pallas_call(
+                functools.partial(kernel),
+                out_shape=x,
+            )(x)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_pallas_call_name_bound_partial_resolved():
+    """The partial is often bound to a local name first
+    (`kernel = functools.partial(fn, ...)` then `pl.pallas_call(kernel)` —
+    paged_flash.py's own shape); the resolver must see through the
+    assignment or the repo's real kernels silently go unanalyzed."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, scale):
+            o_ref[:] = x_ref[:] * scale * time.time()
+
+        def call(x):
+            kernel = functools.partial(_kernel, scale=2.0)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_pallas_call_local_rebinding_shadows_module_def():
+    """Python scoping: a local `kernel = functools.partial(_impure)`
+    shadows a clean module-level `def kernel` — the resolver must analyze
+    the local binding (the function actually traced), not the shadowed
+    def, or the impurity silently escapes."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def _impure(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def call(x):
+            kernel = functools.partial(_impure)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_pallas_call_same_scope_rebinding_wins():
+    """Within one scope the LATEST binding is what runtime traces: a
+    `kernel = functools.partial(_impure)` after a clean local def must be
+    the one analyzed; an unresolvable local rebinding must stop the walk
+    (not fall through to a shadowed outer def)."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _impure(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def call(x):
+            def kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+            kernel = functools.partial(_impure)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+    findings = lint(
+        """
+        import time
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def make_kernel():
+            return None
+
+        def call(x):
+            kernel = make_kernel()  # unresolvable local: shadows the def
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_call_rebinding_after_use_ignored():
+    """A rebinding AFTER the pallas_call line has not executed when the
+    call runs: the clean def actually traced must be the one analyzed —
+    blaming the later impure rebinding is a false positive."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _impure(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def call(x):
+            def kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+            y = pl.pallas_call(kernel, out_shape=x)(x)
+            kernel = functools.partial(_impure)
+            return y
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_call_opaque_local_bindings_stop_walk():
+    """Tuple unpacking (and for/with targets) bind the name just as a
+    plain assignment does: the resolver must stop at the opaque local
+    binding, not blame a shadowed impure module-level def."""
+    findings = lint(
+        """
+        import time
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def make_kernels():
+            return None, None
+
+        def call(x):
+            kernel, cfg = make_kernels()
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_call_class_scope_not_in_method_chain():
+    """Python skips class scope when resolving names inside methods: a
+    sibling impure method named `kernel` must not be blamed when the bare
+    name actually resolves to the clean module-level def."""
+    findings = lint(
+        """
+        import time
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        class Runner:
+            def kernel(self, x_ref, o_ref):
+                o_ref[:] = x_ref[:] * time.time()
+
+            def call(self, x):
+                return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_call_ann_assign_binding_resolved():
+    """An annotated assignment (`kernel: Callable = partial(...)`) binds
+    exactly like a plain one: the impure kernel must be analyzed, and an
+    AnnAssign shadowing a module def must stop the walk."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from typing import Callable
+        from jax.experimental import pallas as pl
+
+        def _impure(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def call(x):
+            kernel: Callable = functools.partial(_impure)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" in rules_of(findings)
+
+
+def test_pallas_call_param_shadows_module_def():
+    """A parameter named like a module-level def shadows it: the traced
+    kernel is whatever the caller passes, so the resolver must stop
+    rather than blame the (possibly impure) module def."""
+    findings = lint(
+        """
+        import time
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def call(x, kernel):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_call_foreign_scope_binding_not_resolved():
+    """A sibling function's LOCAL `kernel = partial(...)` binds that
+    function's namespace only: it must not resolve for an outer
+    `pallas_call(kernel)` whose name the resolver can't actually see
+    (flagging the wrong function would false-positive clean code)."""
+    findings = lint(
+        """
+        import time
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _impure(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * time.time()
+
+        def helper(x):
+            kernel = functools.partial(_impure)
+            return kernel
+
+        def call(x, kernel):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+def test_pallas_kernel_ref_writes_not_flagged():
+    """Ref/scratch writes are writes to kernel ARGUMENTS — the whole point
+    of a kernel — and must not trip the closure-mutation rule; closing
+    over and mutating host state must."""
+    findings = lint(
+        """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref, acc_scratch):
+            acc_scratch[:] = jnp.zeros_like(acc_scratch)
+            o_ref[:] = x_ref[:] + acc_scratch[:]
+
+        def call(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL303" not in rules_of(findings)
+    assert "RTL301" not in rules_of(findings)
+
+    findings = lint(
+        """
+        from jax.experimental import pallas as pl
+
+        stats = {}
+
+        def kernel(x_ref, o_ref):
+            stats["traces"] = 1
+            o_ref[:] = x_ref[:]
+
+        def call(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    )
+    assert "RTL303" in rules_of(findings)
+
+
 def test_pure_jax_random_not_flagged():
     findings = lint(
         """

@@ -524,6 +524,83 @@ def test_engine_preempt_resume_hits_prefix_cache_and_matches_uncached():
     assert outs_cached == outs_uncached
 
 
+def test_engine_greedy_identical_pallas_vs_reference():
+    """Acceptance: greedy outputs are token-identical with the fused
+    Pallas paged-attention kernel on vs off (CPU interpret mode runs the
+    same kernel the TPU compiles), across full prefill, partial prefill
+    (repeated prompt → prefix-cache hit), CoW, and decode — and both match
+    the unbatched full-forward ground truth."""
+    # max_blocks_per_seq bounds the kernel grid (nb + 1 sequential steps
+    # per batch row): keep the table narrow so the interpret-mode compile
+    # stays well under the tier-1 budget.
+    kw = dict(
+        block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=4
+    )
+    prompts = random_prompts((5, 11, 16), seed=31)
+    prompts.append(list(prompts[1]))  # repeat 11-tok: partial-prefill path
+    prompts.append(list(prompts[2]))  # repeat 2 full blocks: CoW path
+    outs = {}
+    for impl in ("reference", "pallas"):
+        eng = LLMEngine(TINY, EngineConfig(**kw, attn_impl=impl), seed=0)
+        outs[impl] = eng.generate(prompts, max_new_tokens=4)
+        assert eng.stats()["attn_impl"] == impl
+        assert eng.stats()["prefix_cache_hit_tokens"] > 0
+    assert outs["pallas"] == outs["reference"]
+    model = GPT(TINY)
+    eng = LLMEngine(TINY, EngineConfig(**kw), seed=0)
+    for prompt, out in zip(prompts, outs["pallas"]):
+        assert out == reference_greedy(model, eng.runner.params, prompt, 4)
+
+
+def test_engine_int8_kv_cache_matches_reference_argmax():
+    """Acceptance: int8 KV (per-token scales, dequant fused into the
+    attention op) keeps greedy argmax identical to the full-precision
+    engine on the acceptance prompt set, with both attention impls, and
+    the pools/scales actually store int8."""
+    kw = dict(
+        block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=4
+    )
+    prompts = random_prompts((5, 11, 17), seed=32)
+    exact = LLMEngine(TINY, EngineConfig(**kw), seed=0)
+    want = exact.generate(prompts, max_new_tokens=4)
+    for impl in ("reference", "pallas"):
+        eng = LLMEngine(
+            TINY,
+            EngineConfig(**kw, attn_impl=impl, kv_cache_dtype="int8"),
+            seed=0,
+        )
+        assert eng.runner.k_cache.dtype == jnp.int8
+        assert eng.runner.k_scale is not None
+        assert eng.runner.k_scale.shape == eng.runner.k_cache.shape[:-1]
+        got = eng.generate(prompts, max_new_tokens=4)
+        assert got == want, f"int8 KV diverged from reference with {impl}"
+        assert eng.stats()["kv_cache_dtype"] == "int8"
+
+
+def test_engine_int8_kv_cow_copies_scales():
+    """A copy-on-write block copy on int8 pools must carry the dequant
+    scales with the values — a fully-cached repeated prompt (the CoW
+    path) stays token-identical to the uncached run."""
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=32, max_decode_slots=4, max_blocks_per_seq=8,
+        kv_cache_dtype="int8",
+    )
+    eng = LLMEngine(TINY, ecfg, seed=0)
+    prompt = random_prompts((16,), seed=33)[0]  # exactly 2 full blocks
+    out1 = eng.generate([prompt], max_new_tokens=4)[0]
+    cows_before = eng.scheduler.num_cow_blocks
+    out2 = eng.generate([prompt], max_new_tokens=4)[0]
+    assert eng.scheduler.num_cow_blocks == cows_before + 1
+    assert out2 == out1
+
+
+def test_engine_config_hot_path_knob_validation():
+    with pytest.raises(ValueError, match="attn_impl"):
+        EngineConfig(attn_impl="cuda")
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        EngineConfig(kv_cache_dtype="fp4")
+
+
 def test_llm_server_warmup_respects_admission_limits():
     """Regression: init-time warmup must shape its requests to pass the
     engine's own admission validation for any valid config (custom buckets

@@ -236,15 +236,19 @@ def test_request_latency_histogram_counts_match_requests_served():
     )
     # One queue sample per admission (>= one per request; preemption adds).
     assert count_of("llm_request_queue_time_seconds") >= len(prompts)
-    # Step histogram carries per-phase series with cumulative le buckets.
+    # Step histogram carries per-phase series with cumulative le buckets,
+    # tagged with the resolved paged-attention implementation so the
+    # dashboards can attribute kernel speedups per phase. Full prefill
+    # never dispatches on the knob, so its series is tagged "n/a".
+    impl = eng.stats()["attn_impl"]
     assert re.search(
-        rf'llm_engine_step_seconds_bucket{{engine="{engine_tag}",'
-        rf'le="\+Inf",phase="decode"}} \d+',
+        rf'llm_engine_step_seconds_bucket{{attn_impl="{impl}",'
+        rf'engine="{engine_tag}",le="\+Inf",phase="decode"}} \d+',
         text,
     )
     assert re.search(
-        rf'llm_engine_step_seconds_count{{engine="{engine_tag}",'
-        rf'phase="prefill"}} \d+',
+        rf'llm_engine_step_seconds_count{{attn_impl="n/a",'
+        rf'engine="{engine_tag}",phase="prefill"}} \d+',
         text,
     )
 

@@ -7,7 +7,15 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops import flash_attention, mha_reference, ring_self_attention
+from ray_tpu.ops import (
+    dequantize_kv,
+    flash_attention,
+    mha_reference,
+    paged_attention,
+    paged_flash_attention,
+    quantize_kv,
+    ring_self_attention,
+)
 from ray_tpu.parallel import MeshSpec
 
 
@@ -104,6 +112,180 @@ def test_packed_flash_single_subtile_odd_seq():
 
     out = flash_attention_packed(qkv, H, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref(qkv)), atol=2e-5)
+
+
+# ---------------- fused paged attention (serving hot path) ----------------
+
+
+def _paged_case(seed, b, s, h=4, d=16, num_blocks=None, bs=4, nb=4):
+    """Random paged-attention inputs: pools, 0-padded tables, new K/V."""
+    if num_blocks is None:
+        num_blocks = b * nb + 1  # enough distinct non-null blocks per row
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k_cache = jnp.asarray(rng.randn(num_blocks, bs, h, d), jnp.float32)
+    v_cache = jnp.asarray(rng.randn(num_blocks, bs, h, d), jnp.float32)
+    new_k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    new_v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    # Distinct non-null blocks per row, 0-padded past each row's blocks.
+    tables = np.zeros((b, nb), np.int32)
+    perm = rng.permutation(np.arange(1, num_blocks))
+    for i in range(b):
+        tables[i] = perm[i * nb : (i + 1) * nb]
+    return q, k_cache, v_cache, jnp.asarray(tables), new_k, new_v
+
+
+@pytest.mark.parametrize(
+    "ctx_lens",
+    [
+        (9, 2, 16, 0),    # partial block / tiny / max / empty padded slot
+        (8, 4, 12, 16),   # block boundaries and full table
+    ],
+)
+def test_paged_flash_decode_matches_reference(ctx_lens):
+    """Decode shape (S == 1): the fused kernel walking the block table must
+    equal the XLA gather+softmax reference at every context length —
+    including 0 (an idle padded slot attending only its own new token),
+    exact block boundaries, and the full table."""
+    q, kc, vc, tables, nk, nv = _paged_case(0, b=4, s=1)
+    lens = jnp.asarray(ctx_lens, jnp.int32)
+    want = paged_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    got = paged_flash_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_paged_flash_partial_prefill_matches_reference():
+    """Partial prefill (S > 1): paged over the cached prefix, causal among
+    the suffix tokens riding along as new_k/new_v."""
+    q, kc, vc, tables, nk, nv = _paged_case(1, b=3, s=5)
+    lens = jnp.asarray([9, 0, 16], jnp.int32)
+    want = paged_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    got = paged_flash_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    # And against per-position dense attention (the oracle's own oracle).
+    bsz = kc.shape[1]
+    nb = tables.shape[1]
+    for i, ctx in enumerate(ctx for ctx in (9, 0, 16)):
+        k_seq = kc[tables[i]].reshape(1, nb * bsz, *kc.shape[2:])[:, :ctx]
+        v_seq = vc[tables[i]].reshape(1, nb * bsz, *vc.shape[2:])[:, :ctx]
+        for j in range(q.shape[1]):
+            k_full = jnp.concatenate([k_seq, nk[i : i + 1, : j + 1]], axis=1)
+            v_full = jnp.concatenate([v_seq, nv[i : i + 1, : j + 1]], axis=1)
+            dense = mha_reference(q[i : i + 1, j : j + 1], k_full, v_full)
+            np.testing.assert_allclose(
+                np.asarray(got[i : i + 1, j : j + 1]),
+                np.asarray(dense),
+                atol=1e-5,
+            )
+
+
+def test_paged_flash_null_padded_table_ignored():
+    """Rows whose table is padded with the null block past their real
+    blocks must not read it: mutating block 0 cannot change the output."""
+    q, kc, vc, tables, nk, nv = _paged_case(2, b=2, s=1)
+    lens = jnp.asarray([6, 10], jnp.int32)
+    out1 = paged_flash_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    kc2 = kc.at[0].set(1e6)
+    vc2 = vc.at[0].set(-1e6)
+    out2 = paged_flash_attention(q, kc2, vc2, tables, lens, new_k=nk, new_v=nv)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_paged_attention_empty_context_returns_zeros():
+    """Regression: context_lens == 0 with no new tokens used to softmax
+    over all-NEG_INF logits — uniform weights over garbage gathered from
+    the null block. Masked/empty slots must return exact zeros."""
+    rng = np.random.RandomState(3)
+    kc = jnp.asarray(rng.randn(6, 4, 2, 8), jnp.float32)
+    vc = jnp.asarray(1e3 * rng.randn(6, 4, 2, 8), jnp.float32)  # loud garbage
+    q = jnp.asarray(rng.randn(2, 1, 2, 8), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+    lens = jnp.asarray([0, 5], jnp.int32)
+    out = paged_attention(q, kc, vc, tables, lens)
+    assert np.all(np.asarray(out[0]) == 0.0)  # exact zeros, not garbage
+    assert np.any(np.asarray(out[1]) != 0.0)  # live rows unaffected
+
+
+def test_paged_flash_int8_matches_int8_reference():
+    """int8 KV: the kernel's fused dequant (scales folded into the score /
+    weight matrices) must match the reference dequantizing gathered pages
+    — same quantized inputs, near-identical outputs."""
+    q, kc, vc, tables, nk, nv = _paged_case(4, b=3, s=2)
+    lens = jnp.asarray([9, 16, 0], jnp.int32)
+    kq, ks = quantize_kv(kc)
+    vq, vs = quantize_kv(vc)
+    assert kq.dtype == jnp.int8 and ks.shape == kc.shape[:-1]
+    want = paged_attention(
+        q, kq, vq, tables, lens, new_k=nk, new_v=nv, k_scale=ks, v_scale=vs
+    )
+    got = paged_flash_attention(
+        q, kq, vq, tables, lens, new_k=nk, new_v=nv, k_scale=ks, v_scale=vs
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    # And the quantized result stays within quantization tolerance of the
+    # exact f32 computation.
+    exact = paged_attention(q, kc, vc, tables, lens, new_k=nk, new_v=nv)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exact), atol=0.05)
+
+
+def test_quantize_kv_round_trip():
+    """Per-token int8 quantization: sub-1% round-trip error, exact-zero
+    preservation, and int8 range discipline."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(7, 3, 4, 32) * 3.0, jnp.float32)
+    qv, sc = quantize_kv(x)
+    assert qv.dtype == jnp.int8 and sc.shape == (7, 3, 4)
+    assert int(jnp.max(jnp.abs(qv.astype(jnp.int32)))) <= 127
+    back = dequantize_kv(qv, sc)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    assert np.all(err <= amax / 127.0 + 1e-6)  # half-step + scale rounding
+    z, zs = quantize_kv(jnp.zeros((2, 1, 4, 8)))
+    assert np.all(np.asarray(z) == 0)
+    assert np.all(np.asarray(dequantize_kv(z, zs)) == 0.0)
+
+
+def test_paged_flash_requires_new_kv():
+    q, kc, vc, tables, nk, nv = _paged_case(6, b=1, s=1)
+    lens = jnp.asarray([4], jnp.int32)
+    with pytest.raises(ValueError, match="new_k/new_v"):
+        paged_flash_attention(q, kc, vc, tables, lens, new_k=None, new_v=None)
+    # Scales with non-int8 pools must raise in BOTH implementations —
+    # silently dropping (kernel) or applying (reference) them would make
+    # impl='auto' platform-dependent.
+    _, ks = quantize_kv(kc)
+    _, vs = quantize_kv(vc)
+    kq, _ = quantize_kv(kc)
+    vq, _ = quantize_kv(vc)
+    for op in (paged_flash_attention, paged_attention):
+        with pytest.raises(ValueError, match="non-int8"):
+            op(
+                q, kc, vc, tables, lens, new_k=nk, new_v=nv,
+                k_scale=ks, v_scale=vs,
+            )
+        # ...and the mirror: int8 pools without scales.
+        with pytest.raises(ValueError, match="require k_scale/v_scale"):
+            op(q, kq, vq, tables, lens, new_k=nk, new_v=nv)
+
+
+def test_paged_attention_impl_dispatcher():
+    """impl='auto' takes the reference on CPU; 'pallas' forces the kernel
+    (interpret mode here); both agree, unknown impls are rejected."""
+    from ray_tpu.ops import paged_attention_impl
+
+    q, kc, vc, tables, nk, nv = _paged_case(7, b=2, s=1)
+    lens = jnp.asarray([6, 3], jnp.int32)
+    auto = paged_attention_impl(
+        q, kc, vc, tables, lens, new_k=nk, new_v=nv, impl="auto"
+    )
+    forced = paged_attention_impl(
+        q, kc, vc, tables, lens, new_k=nk, new_v=nv, impl="pallas"
+    )
+    np.testing.assert_allclose(np.asarray(forced), np.asarray(auto), atol=1e-5)
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention_impl(
+            q, kc, vc, tables, lens, new_k=nk, new_v=nv, impl="cuda"
+        )
 
 
 def test_flash_attention_backward_matches_reference():
