@@ -1,0 +1,27 @@
+# Developer / CI entry points. The lint gate runs OUTSIDE pytest too so a
+# tree-clean check needs no test collection (and CI can annotate from the
+# SARIF output without running the suite).
+
+PY ?= python
+
+.PHONY: lint lint-sarif lint-json test test-lint
+
+# Tree-clean gate: exit 1 on any active finding, untriaged baseline
+# entry, stale baseline entry, or parse error. Same entry point as the
+# `ray-tpu-lint` console script and `ray-tpu lint`.
+lint:
+	$(PY) -m ray_tpu.tools.lint ray_tpu
+
+# CI annotation feed (SARIF 2.1.0 — GitHub code scanning et al.).
+lint-sarif:
+	$(PY) -m ray_tpu.tools.lint ray_tpu --sarif
+
+lint-json:
+	$(PY) -m ray_tpu.tools.lint ray_tpu --json
+
+# Lint unit suite only (fast; the full tier-1 run includes it).
+test-lint:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lint.py -q
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -20,6 +20,7 @@ from collections import defaultdict
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu
@@ -130,6 +131,10 @@ class EnvRunner:
         )
         B = self.num_envs
         cols: dict[str, list] = defaultdict(list)
+        # Jitted path: per-step forward outputs other than the actions
+        # stay ON DEVICE during the loop and transfer once per fragment
+        # (see the stacked fetch after the loop).
+        dev_cols: dict[str, list] = defaultdict(list)
         use_np = self._np_explore is not None
         if not use_np:
             # One split for the whole fragment instead of one jitted split
@@ -156,6 +161,11 @@ class EnvRunner:
                 fwd = self._explore_fn(
                     self.module.params, fwd_in, keys[t_step + 1]
                 )
+            # The env step needs host actions — this sync is the step
+            # boundary itself and cannot move out of the loop.
+            # ray-tpu: lint-ignore[RTL503] vector_env.step consumes host
+            # actions; every other forward output defers to the stacked
+            # post-loop fetch below
             actions = np.asarray(fwd[SampleBatch.ACTIONS])
             env_actions = actions
             if self._is_continuous:
@@ -171,8 +181,16 @@ class EnvRunner:
             cols[SampleBatch.TERMINATEDS].append(terms)
             cols[SampleBatch.TRUNCATEDS].append(truncs)
             for key_, val in fwd.items():
-                if key_ != SampleBatch.ACTIONS:
-                    cols[key_].append(np.asarray(val))
+                if key_ == SampleBatch.ACTIONS:
+                    continue
+                if use_np:
+                    cols[key_].append(val)  # np fast path: host arrays
+                else:
+                    # Keep the device array: converting each output every
+                    # step cost one host transfer per leaf per step (an
+                    # RTT each on a tunneled TPU); the action fetch above
+                    # already synchronized this step's compute.
+                    dev_cols[key_].append(val)
             # NEXT_OBS must be the transition's true successor state: at
             # done steps the vector env auto-reset, so substitute the final
             # observation (replay-based TD targets and V-trace bootstraps
@@ -221,6 +239,12 @@ class EnvRunner:
                 self._eps_id[i] = self._next_eps
                 self._next_eps += 1
             self._obs = next_obs
+        # One stacked device->host transfer per forward output for the
+        # whole fragment: T*k per-leaf syncs inside the loop become k
+        # here, with every value long since computed (the per-step action
+        # fetch bounded each step).
+        for key_, vals in dev_cols.items():
+            cols[key_] = list(np.asarray(jnp.stack(vals)))
         # Fragment cut: running episodes bootstrap from V(current obs).
         running = ~(cols[SampleBatch.TERMINATEDS][-1] | cols[SampleBatch.TRUNCATEDS][-1])
         if self._vf_fn is not None and running.any():

@@ -143,7 +143,16 @@ class MultiAgentEnvRunner:
                 )
             )
             fwd = self._explore_fn(self.module.params, fwd_in, key)
-            actions = np.asarray(fwd[SampleBatch.ACTIONS])
+            # One host fetch per forward output per env step — the step
+            # boundary must sync anyway for the env actions. The per-agent
+            # row loop below then indexes HOST arrays; it used to call
+            # np.asarray(val) once per agent per output, re-transferring
+            # the same device array len(agents) times every step.
+            # ray-tpu: lint-ignore[RTL503] env.step consumes host actions
+            # each step; this single per-output fetch replaces a
+            # per-agent re-conversion of the same arrays
+            fwd_host = {k: np.asarray(v) for k, v in fwd.items()}
+            actions = fwd_host[SampleBatch.ACTIONS]
             env_actions = actions
             if self._is_continuous:
                 env_actions = np.clip(
@@ -174,9 +183,9 @@ class MultiAgentEnvRunner:
                 if agent not in self._agent_eps:
                     self._agent_eps[agent] = self._new_eps_id(agent)
                 r[SampleBatch.EPS_ID].append(self._agent_eps[agent])
-                for key_, val in fwd.items():
+                for key_, val in fwd_host.items():
                     if key_ != SampleBatch.ACTIONS:
-                        r[key_].append(np.asarray(val)[i])
+                        r[key_].append(val[i])  # host array, fetched once
                 successor = next_obs.get(agent)
                 if successor is None:
                     successor = infos.get(agent, {}).get(
@@ -185,6 +194,10 @@ class MultiAgentEnvRunner:
                 r[SampleBatch.NEXT_OBS].append(np.asarray(successor, np.float32))
                 boot = 0.0
                 if trunc and self._vf_fn is not None:
+                    # ray-tpu: lint-ignore[RTL503] runs only at truncation
+                    # boundaries (rare), and the bootstrap value feeds the
+                    # row being built this step — deferring it would mean
+                    # re-walking every agent's rows after the loop
                     boot = float(
                         np.asarray(
                             self._vf_fn(
@@ -207,6 +220,8 @@ class MultiAgentEnvRunner:
                 self._finish_episode()
 
         batches = []
+        pending: list[tuple[SampleBatch, int]] = []  # (batch, cut-obs row)
+        cut_obs: list[np.ndarray] = []
         for agent, cols in rows.items():
             if not cols[SampleBatch.OBS]:
                 continue
@@ -216,25 +231,29 @@ class MultiAgentEnvRunner:
                     for k, v in cols.items()
                 }
             )
-            # Fragment-cut bootstrap for agents still running.
+            # Fragment-cut bootstrap for agents still running: collect the
+            # cut observations and run ONE batched value call below — the
+            # per-agent loop used to pay one jit dispatch + host sync per
+            # running agent per fragment.
             if (
                 self._vf_fn is not None
                 and not batch[SampleBatch.TERMINATEDS][-1]
                 and not batch[SampleBatch.TRUNCATEDS][-1]
                 and agent in self._obs
             ):
-                val = float(
-                    np.asarray(
-                        self._vf_fn(
-                            self.module.params,
-                            np.asarray(self._obs[agent], np.float32)[None],
-                        )
-                    )[0]
-                )
-                vb = np.asarray(batch[SampleBatch.VALUES_BOOTSTRAPPED])
-                vb[-1] = val
-                batch[SampleBatch.VALUES_BOOTSTRAPPED] = vb
+                pending.append((batch, len(cut_obs)))
+                cut_obs.append(np.asarray(self._obs[agent], np.float32))
             batches.append(batch)
+        if pending:
+            # Batch size = number of cut agents, bounded by the env's
+            # agent count — at most a handful of compiled shapes.
+            vals = np.asarray(
+                self._vf_fn(self.module.params, np.stack(cut_obs))
+            )
+            for batch, row in pending:
+                vb = np.asarray(batch[SampleBatch.VALUES_BOOTSTRAPPED])
+                vb[-1] = float(vals[row])
+                batch[SampleBatch.VALUES_BOOTSTRAPPED] = vb
         out = SampleBatch.concat_samples(batches)
         self._steps_sampled += env_steps
         if getattr(self.config, "_compute_gae_on_runner", True) and self._has_vf:
@@ -389,7 +408,16 @@ class PerPolicyMultiAgentRunner(MultiAgentEnvRunner):
                 fwd_in = {SampleBatch.OBS: obs_stack}
                 fwd_in.update(module.exploration_inputs(timestep))
                 fwd = self._explore_fns[pid](module.params, fwd_in, key)
-                actions = np.asarray(fwd[SampleBatch.ACTIONS])
+                # One host fetch per forward output per policy per step
+                # (the env step needs host actions regardless); the
+                # per-agent dict below then slices HOST arrays — the old
+                # `np.asarray(v)[j]` re-transferred each device array
+                # once per member agent.
+                # ray-tpu: lint-ignore[RTL503] env.step consumes host
+                # actions each step; single per-output fetch replaces a
+                # per-member re-conversion of the same arrays
+                fwd_host = {k: np.asarray(v) for k, v in fwd.items()}
+                actions = fwd_host[SampleBatch.ACTIONS]
                 env_actions = actions
                 if self._is_continuous:
                     env_actions = np.clip(
@@ -399,7 +427,7 @@ class PerPolicyMultiAgentRunner(MultiAgentEnvRunner):
                     )
                 for j, agent in enumerate(members):
                     fwd_by_agent[agent] = {
-                        k: np.asarray(v)[j] for k, v in fwd.items()
+                        k: v[j] for k, v in fwd_host.items()
                     }
                     action_dict[agent] = env_actions[j]
             obs_before = dict(self._obs)

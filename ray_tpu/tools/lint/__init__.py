@@ -1,20 +1,33 @@
 """ray-tpu lint: codebase-aware static analyzer.
 
-Four rule families tuned to this repo's hazard classes (every one of
-which previously shipped a hand-found bug — see CHANGES.md):
+Seven rule families tuned to this repo's hazard classes (every one of
+which previously shipped a hand-found bug — see CHANGES.md). The first
+four are per-module; the last three ride the PROJECT-LEVEL pass
+(`project.py`): a cross-module symbol table (import-alias chains,
+`__init__.py` re-exports), a call graph, and an actor-method index, so
+resolution follows code across files:
 
   * async (RTL1xx)     — blocking calls in `async def`, await while
                          holding a threading lock, unawaited coroutines
   * locks (RTL2xx)     — per-class lock-coverage inference: state mutated
                          under `self._lock` accessed bare elsewhere
   * trace (RTL3xx)     — host side effects / state mutation inside
-                         `jax.jit`/`pjit`/`shard_map` functions, and
-                         wall-clock duration/deadline arithmetic
+                         `jax.jit`/`pjit`/`shard_map` functions (now
+                         resolved across modules), and wall-clock
+                         duration/deadline arithmetic
   * resources (RTL4xx) — dropped ObjectRefs, rollback markers cleared
                          before commit, allocate/free exception safety
+  * donation (RTL5xx)  — use-after-donate on jitted buffers, unstable
+                         jit signatures (retrace storms), host-device
+                         syncs inside step loops
+  * sharding (RTL6xx)  — PartitionSpec axes absent from the call-site
+                         mesh, collectives naming unbound axis names
+  * actors (RTL7xx)    — blocking get on a same-actor task, synchronous
+                         cross-actor call cycles (graph SCCs)
 
-Entry points: `ray-tpu lint`, `python -m ray_tpu.tools.lint`, or
-`lint_source()` / `lint_paths()` from Python (tests use both).
+Entry points: `ray-tpu lint`, `python -m ray_tpu.tools.lint`, `make
+lint`, or `lint_source()` / `lint_sources()` / `lint_paths()` from
+Python (tests use all three).
 """
 
 from ray_tpu.tools.lint.core import (  # noqa: F401
@@ -24,4 +37,5 @@ from ray_tpu.tools.lint.core import (  # noqa: F401
     find_repo_root,
     lint_paths,
     lint_source,
+    lint_sources,
 )

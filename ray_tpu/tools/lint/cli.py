@@ -1,23 +1,28 @@
 """`ray-tpu lint` — CLI for the codebase-aware static analyzer.
 
-    ray-tpu lint [paths ...] [--rule ID] [--json] [--baseline FILE]
-                 [--write-baseline] [--list-rules] [--no-baseline]
+    ray-tpu lint [paths ...] [--rule ID] [--json] [--sarif]
+                 [--baseline FILE] [--write-baseline] [--list-rules]
+                 [--no-baseline] [--explain RULE]
 
 Exit codes: 0 — clean (every finding fixed, suppressed with a reason, or
 baselined with a reason); 1 — active findings (or untriaged baseline
 entries); 2 — usage/parse errors.
 
 `--json` emits a machine-readable report (consumed by the dashboard and
-tests):
+tests). `version` is the SCHEMA version — bumped to 2 with the
+project-level pass (new keys never appear under an old version number,
+so consumers can gate on it):
 
     {
-      "version": 1,
+      "version": 2,
+      "schema": "ray-tpu-lint-report/2",
       "root": "/abs/repo",
       "paths": ["ray_tpu"],
       "files_scanned": 240,
       "duration_s": 1.8,
       "counts": {"active": 0, "baselined": 12, "suppressed": 4,
-                 "parse_errors": 0, "stale_baseline": 0},
+                 "parse_errors": 0, "stale_baseline": 0,
+                 "untriaged_baseline": 0},
       "findings": [ {rule, name, family, path, line, col, context,
                      message, fingerprint}, ... ],
       "parse_errors": [ {...}, ... ],
@@ -27,6 +32,16 @@ tests):
 
 `counts.active == len(findings)` always; unparseable files are reported
 in their own `parse_errors` array (counted by `counts.parse_errors`).
+
+`--sarif` emits SARIF 2.1.0 for CI annotation pipelines (GitHub code
+scanning et al.): active findings as `warning` results, parse errors as
+`error`, rule metadata (description + rationale) in the tool driver, and
+the lint fingerprint under `partialFingerprints` so annotation dedup
+survives line drift. Exit codes match the other modes.
+
+`--explain RULE` prints the rule's rationale plus a minimal bad/good
+example pair — the SAME snippets the fixture tests run, so the examples
+can never drift from what the rule flags.
 """
 
 from __future__ import annotations
@@ -50,7 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="ray-tpu lint",
         description=(
             "Codebase-aware static analyzer: actor races, async "
-            "deadlocks, JIT trace-safety, resource hygiene"
+            "deadlocks, JIT trace-safety, resource hygiene, buffer "
+            "donation, retrace storms, sharding consistency, actor "
+            "call-graph deadlocks"
         ),
     )
     parser.add_argument(
@@ -82,7 +99,115 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    parser.add_argument(
+        "--sarif", action="store_true",
+        help="SARIF 2.1.0 output (CI annotations / external tooling)",
+    )
+    parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print a rule's rationale + minimal bad/good example",
+    )
     return parser
+
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _sarif_result(finding, level: str) -> dict:
+    return {
+        "ruleId": finding.rule,
+        "level": level,
+        "message": {"text": f"{finding.message} ({finding.context})"},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(finding.line, 1),
+                        "startColumn": finding.col + 1,
+                    },
+                }
+            }
+        ],
+        # The lint fingerprint hashes rule+file+scope+normalized source,
+        # so CI annotation dedup survives line drift exactly like the
+        # checked-in baseline does.
+        "partialFingerprints": {
+            "rayTpuLint/v1": finding.fingerprint or "",
+        },
+    }
+
+
+def sarif_report(result, root: Path) -> dict:
+    rules_meta = [
+        {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.description},
+            "fullDescription": {
+                "text": rule.rationale or rule.description
+            },
+            "properties": {"family": rule.family},
+        }
+        for rule in all_rules()
+    ]
+    results = [_sarif_result(f, "warning") for f in result.findings]
+    results.extend(
+        _sarif_result(f, "error") for f in result.parse_errors
+    )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "ray-tpu-lint",
+                        "informationUri": (
+                            "https://github.com/ray-tpu/ray-tpu"
+                        ),
+                        "rules": rules_meta,
+                    }
+                },
+                "originalUriBaseIds": {
+                    "SRCROOT": {"uri": root.resolve().as_uri() + "/"}
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def explain_rule(rule_id: str) -> int:
+    import textwrap
+
+    for rule in all_rules():
+        if rule.id != rule_id and rule.name != rule_id:
+            continue
+        print(f"{rule.id}  {rule.name}  [{rule.family}]")
+        print(f"\n{rule.description}\n")
+        if rule.rationale:
+            print("Why:")
+            print(textwrap.fill(rule.rationale, width=72,
+                                initial_indent="  ",
+                                subsequent_indent="  "))
+        if rule.bad_example:
+            print("\nFires on:\n")
+            print(textwrap.indent(
+                textwrap.dedent(rule.bad_example).strip(), "    "))
+        if rule.good_example:
+            print("\nClean form:\n")
+            print(textwrap.indent(
+                textwrap.dedent(rule.good_example).strip(), "    "))
+        return 0
+    print(f"ray-tpu lint: no such rule: {rule_id}", file=sys.stderr)
+    return 2
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -93,6 +218,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule.id}  {rule.name:24s} [{rule.family}] "
                   f"{rule.description}")
         return 0
+
+    if args.explain:
+        return explain_rule(args.explain)
 
     paths = [Path(p) for p in args.paths]
     missing = [p for p in paths if not p.exists()]
@@ -176,9 +304,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         }
     )
 
-    if args.json:
+    if args.sarif:
+        print(json.dumps(sarif_report(result, root), indent=2))
+    elif args.json:
         report = {
-            "version": 1,
+            "version": 2,
+            "schema": "ray-tpu-lint-report/2",
             "root": str(root),
             "paths": [str(p) for p in paths],
             "files_scanned": result.files_scanned,

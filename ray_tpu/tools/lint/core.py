@@ -27,7 +27,10 @@ import time
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-FAMILIES = ("meta", "async", "locks", "trace", "resources")
+FAMILIES = (
+    "meta", "async", "locks", "trace", "resources",
+    "donation", "sharding", "actors",
+)
 
 SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist", "node_modules"}
 SKIP_FILE_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
@@ -97,6 +100,17 @@ def _matching_suppression(
 _SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
+def module_name_for(relpath: str) -> str:
+    """Dotted module name of a repo-relative path:
+    "ray_tpu/llm/engine.py" -> "ray_tpu.llm.engine",
+    "ray_tpu/llm/__init__.py" -> "ray_tpu.llm"."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [part for part in p.split("/") if part]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
 class ModuleInfo:
     """One parsed source file plus the shared derived structure.
 
@@ -112,6 +126,9 @@ class ModuleInfo:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
+        # Backref set by ProjectInfo when this module is part of a
+        # project-level scan; None for standalone snippets.
+        self.project = None
         self.parents: Dict[int, ast.AST] = {}
         self.by_type: Dict[type, List[ast.AST]] = {}
         # scope node (Module/FunctionDef/AsyncFunctionDef/Lambda) id ->
@@ -134,7 +151,9 @@ class ModuleInfo:
                     self.scope_nodes[id(scope)].append(child)
                 stack.append((child, child_scope))
         # name -> dotted module ("np" -> "numpy"); from-imports map the
-        # bound name to "module.attr" ("jit" -> "jax.jit").
+        # bound name to "module.attr" ("jit" -> "jax.jit"). Relative
+        # imports resolve against this file's package so a project-level
+        # scan can follow `from .engine import X` across files.
         self.aliases: Dict[str, str] = {}
         for node in self.nodes(ast.Import):
             for a in node.names:
@@ -142,14 +161,32 @@ class ModuleInfo:
                     a.name if a.asname else a.name.split(".")[0]
                 )
         for node in self.nodes(ast.ImportFrom):
-            if not node.module:
+            base = self._import_base(node)
+            if base is None:
                 continue
             for a in node.names:
                 if a.name == "*":
                     continue
-                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+                self.aliases[a.asname or a.name] = f"{base}.{a.name}"
         self.suppressions = self._parse_suppressions()
         self._expand_suppressions()
+
+    def _import_base(self, node: ast.ImportFrom) -> Optional[str]:
+        """Absolute dotted base of an ImportFrom. `from a.b import c` ->
+        "a.b"; `from .sib import c` in pkg/mod.py -> "pkg.sib"; a relative
+        import that climbs above the scan root resolves to None."""
+        if not node.level:
+            return node.module
+        pkg_parts = module_name_for(self.relpath).split(".")
+        if not self.relpath.endswith("__init__.py"):
+            pkg_parts = pkg_parts[:-1]  # plain module: package is the dir
+        drop = node.level - 1
+        if drop > len(pkg_parts):
+            return None
+        base_parts = pkg_parts[: len(pkg_parts) - drop]
+        if node.module:
+            base_parts.append(node.module)
+        return ".".join(base_parts) or None
 
     def nodes(self, *types: type) -> List[ast.AST]:
         if len(types) == 1:
@@ -291,11 +328,281 @@ def qualname_of(module: ModuleInfo, node: ast.AST) -> str:
     return ".".join(reversed(parts)) or "<module>"
 
 
+# -- name/function binding resolution (shared by rule families) -------------
+
+
+def call_kwargs(call: ast.Call) -> Dict[str, ast.AST]:
+    """Named keyword arguments of a call (a `**splat` contributes none)."""
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _target_binds(target: ast.AST, name: str) -> bool:
+    """Does an assignment-like target bind `name`? Sees through tuple /
+    list unpacking and starred elements."""
+    if isinstance(target, ast.Name):
+        return target.id == name
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_target_binds(el, name) for el in target.elts)
+    if isinstance(target, ast.Starred):
+        return _target_binds(target.value, name)
+    return False
+
+
+def _param_names(fn: ast.AST) -> set:
+    a = fn.args
+    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg is not None:
+        names.add(a.vararg.arg)
+    if a.kwarg is not None:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _scope_level_nodes(scope: ast.AST):
+    """Nodes lexically inside `scope` without descending into nested
+    scopes — a function/class body introduces its own namespace, so its
+    bindings are not visible where `scope`'s are."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _binding_of(node: ast.AST, name: str) -> Optional[ast.AST]:
+    """The node, when it is a statement binding `name` (def, assignment,
+    for/with target); else None."""
+    if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ) and node.name == name:
+        return node
+    if isinstance(node, ast.Assign) and any(
+        _target_binds(t, name) for t in node.targets
+    ):
+        return node
+    if isinstance(
+        node, (ast.AnnAssign, ast.NamedExpr)
+    ) and _target_binds(node.target, name):
+        return node
+    if isinstance(node, (ast.For, ast.AsyncFor)) and _target_binds(
+        node.target, name
+    ):
+        return node
+    if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+        item.optional_vars is not None
+        and _target_binds(item.optional_vars, name)
+        for item in node.items
+    ):
+        return node
+    return None
+
+
+def _bound_names(node: ast.AST) -> List[str]:
+    """Names an assignment-like statement binds (see _binding_of)."""
+    if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return [node.name]
+    out: List[str] = []
+
+    def collect(target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, ast.Store
+            ):
+                out.append(sub.id)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            collect(t)
+    elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)):
+        collect(node.target)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        collect(node.target)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return out
+
+
+def _module_scope_bindings(module: ModuleInfo) -> Dict[str, ast.AST]:
+    """name -> LAST module-level binding. The module scope is scanned by
+    every name lookup that escapes a function (the call graph does many
+    thousands per scan), so it is memoized once per module; the latest
+    binding wins, matching the non-sequential walk."""
+    cached = module.memo.get("module_scope_bindings")
+    if cached is not None:
+        return cached
+    out: Dict[str, ast.AST] = {}
+    for node in _scope_level_nodes(module.tree):
+        for name in _bound_names(node):
+            prev = out.get(name)
+            if prev is None or node.lineno > prev.lineno:
+                out[name] = node
+    module.memo["module_scope_bindings"] = out
+    return out
+
+
+def resolve_name_binding(
+    module: ModuleInfo, name: str, at: ast.AST
+) -> Optional[ast.AST]:
+    """Latest live binding of a bare name visible at `at`, with the same
+    scoping semantics as `_resolve_function` (innermost scope first,
+    latest binding not past the use site wins inside the function holding
+    `at`, class scope skipped from inside methods, opaque local bindings
+    stop the walk). Returns the binding statement (def / Assign / For /
+    With), or None."""
+    scope = module.parent(at)
+    chain = []
+    while scope is not None:
+        chain.append(scope)
+        scope = module.parent(scope)
+    if not chain or chain[-1] is not module.tree:
+        chain.append(module.tree)
+    sequential = True
+    crossed_function = False
+    for scope in chain:
+        if isinstance(scope, ast.ClassDef) and crossed_function:
+            continue
+        if scope is module.tree and not sequential:
+            # Hot path: every lookup that escapes a function lands here —
+            # use the memoized module-level map instead of rescanning.
+            # (module.tree is always the last scope in the chain, so a
+            # miss here is the walk's final None.)
+            return _module_scope_bindings(module).get(name)
+        best = None
+        for node in _scope_level_nodes(scope):
+            bind = _binding_of(node, name)
+            if bind is not None and sequential and (
+                bind.lineno > getattr(at, "lineno", bind.lineno)
+            ):
+                bind = None
+            if bind is not None and (
+                best is None or bind.lineno > best.lineno
+            ):
+                best = bind
+        if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            sequential = False
+            crossed_function = True
+            if best is None and name in _param_names(scope):
+                return None  # bound by a parameter: opaque
+        if best is not None:
+            return best
+    return None
+
+
+def _resolve_function(
+    module: ModuleInfo, expr: ast.AST, at: ast.AST, _depth: int = 0
+):
+    """Map a function expression to a FunctionDef/Lambda defined in this
+    module: a bare name (module function or sibling nested def), a
+    `self._method`, or an inline lambda. Sees through
+    `functools.partial(fn, ...)` — inline, or bound to a local name first
+    (`kernel = functools.partial(fn, ...)`), the two ways Pallas kernels
+    are handed to pallas_call. None when not resolvable."""
+    if _depth > 8:  # self-referential bindings (f = partial(f, ...))
+        return None
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Call):
+        dotted = module.dotted_name(expr.func)
+        if (
+            dotted is not None
+            and dotted.rsplit(".", 1)[-1] == "partial"
+            and expr.args
+        ):
+            return _resolve_function(module, expr.args[0], at, _depth + 1)
+        return None
+    if isinstance(expr, ast.Name):
+        best = resolve_name_binding(module, expr.id, at)
+        if best is None:
+            return None
+        if isinstance(best, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return best
+        # Some assignment-like form binds the name: resolve its value
+        # where one maps to the name directly, else give up — walking
+        # outward would analyze a shadowed, never-traced binding (tuple
+        # unpacking, for/with targets, bare annotations are all opaque).
+        if isinstance(best, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == expr.id
+            for t in best.targets
+        ):
+            return _resolve_function(module, best.value, at, _depth + 1)
+        if (
+            isinstance(best, (ast.AnnAssign, ast.NamedExpr))
+            and best.value is not None
+        ):
+            return _resolve_function(module, best.value, at, _depth + 1)
+        return None
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        cls = module.parent(at)
+        while cls is not None and not isinstance(cls, ast.ClassDef):
+            cls = module.parent(cls)
+        if cls is not None:
+            for node in cls.body:
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node.name == expr.attr:
+                    return node
+    return None
+
+
+def resolve_function_ex(
+    module: ModuleInfo, expr: ast.AST, at: ast.AST, _depth: int = 0
+) -> Optional[Tuple[ModuleInfo, ast.AST]]:
+    """`_resolve_function` extended across module boundaries: when the
+    expression names an import (directly, through `as`-alias chains, or
+    re-exported by an `__init__.py`), the project symbol table maps it to
+    the defining module's FunctionDef. Returns (defining_module, fn)."""
+    fn = _resolve_function(module, expr, at)
+    if fn is not None:
+        return (module, fn)
+    project = module.project
+    if project is None or _depth > 8:
+        return None
+    if isinstance(expr, ast.Call):
+        dotted = module.dotted_name(expr.func)
+        if (
+            dotted is not None
+            and dotted.rsplit(".", 1)[-1] == "partial"
+            and expr.args
+        ):
+            return resolve_function_ex(module, expr.args[0], at, _depth + 1)
+        return None
+    dotted = module.dotted_name(expr)
+    if dotted is None:
+        return None
+    sym = project.resolve(dotted)
+    if sym is not None and isinstance(
+        sym.node, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        return (sym.module, sym.node)
+    return None
+
+
 class Rule:
     id = "RTL000"
     name = "abstract"
     family = "meta"
     description = ""
+    # `--explain` material: why the rule exists plus a minimal firing /
+    # exempt snippet pair. The same snippets double as fixture tests
+    # (tests/test_lint.py parametrizes over them), so the CLI's examples
+    # can never drift from what the rule actually flags.
+    rationale = ""
+    bad_example = ""
+    good_example = ""
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         raise NotImplementedError
@@ -317,14 +624,25 @@ class Rule:
 
 def all_rules() -> List[Rule]:
     from ray_tpu.tools.lint import (  # noqa: PLC0415 — avoid import cycle
+        rules_actors,
         rules_async,
+        rules_donation,
         rules_locks,
         rules_resources,
+        rules_sharding,
         rules_trace,
     )
 
     rules: List[Rule] = []
-    for mod in (rules_async, rules_locks, rules_trace, rules_resources):
+    for mod in (
+        rules_async,
+        rules_locks,
+        rules_trace,
+        rules_resources,
+        rules_donation,
+        rules_sharding,
+        rules_actors,
+    ):
         rules.extend(r() for r in mod.RULES)
     return rules
 
@@ -432,6 +750,11 @@ def lint_paths(
     suppressions_by_file: Dict[str, Dict[int, List[Suppression]]] = {}
     lines_by_file: Dict[str, List[str]] = {}
     n_files = 0
+    # Two phases: parse EVERYTHING first so the cross-module symbol table
+    # / call graph sees the whole scan, then run rules per module (the
+    # per-module memoization from the single-pass design still holds; the
+    # project adds its own memo for cross-module derived structure).
+    modules: List[ModuleInfo] = []
     for file in iter_python_files([Path(p) for p in paths]):
         n_files += 1
         try:
@@ -454,8 +777,14 @@ def lint_paths(
                 )
             )
             continue
-        suppressions_by_file[relpath] = module.suppressions
-        lines_by_file[relpath] = module.lines
+        modules.append(module)
+
+    from ray_tpu.tools.lint.project import ProjectInfo  # noqa: PLC0415
+
+    ProjectInfo(modules)
+    for module in modules:
+        suppressions_by_file[module.relpath] = module.suppressions
+        lines_by_file[module.relpath] = module.lines
         raw.extend(module.suppression_findings())
         for rule in rules:
             raw.extend(rule.check(module))
@@ -543,27 +872,55 @@ def lint_paths(
 def lint_source(
     source: str,
     rules: Optional[Sequence[Rule]] = None,
-    relpath: str = "<snippet>.py",
+    relpath: str = "snippet.py",
 ) -> List[Finding]:
     """Run rules on an in-memory snippet (test harness entry point);
     returns ALL findings, honoring inline suppressions but no baseline."""
-    module = ModuleInfo(Path(relpath), relpath, source)
+    return lint_sources({relpath: source}, rules=rules)
+
+
+def lint_sources(
+    sources: Dict[str, str],
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Finding]:
+    """Run rules on a dict of in-memory modules {relpath: source} — the
+    multi-file test harness for cross-module rules. All modules join one
+    ProjectInfo (symbol table / call graph / actor index span the dict);
+    findings from every module come back in one sorted list."""
+    from ray_tpu.tools.lint.project import ProjectInfo  # noqa: PLC0415
+
+    modules = [
+        ModuleInfo(Path(relpath), relpath, source)
+        for relpath, source in sources.items()
+    ]
+    ProjectInfo(modules)
     full_run = rules is None
     rules = list(rules) if rules is not None else all_rules()
-    raw = list(module.suppression_findings())
-    for rule in rules:
-        raw.extend(rule.check(module))
+    raw: List[Finding] = []
+    for module in modules:
+        raw.extend(module.suppression_findings())
+        for rule in rules:
+            raw.extend(rule.check(module))
     raw.sort(key=Finding.key)
-    out = []
+    # A cross-module rule can attribute a finding to the DEFINING module
+    # while checking the importing one — classify suppressions by the
+    # finding's own path, exactly as lint_paths does.
+    sups_by_path = {m.relpath: m.suppressions for m in modules}
+    out: List[Finding] = []
     for f in raw:
-        sup = _matching_suppression(module.suppressions.get(f.line), f)
+        sup = _matching_suppression(
+            sups_by_path.get(f.path, {}).get(f.line), f
+        )
         if sup is not None:
             sup.used = True
             continue
         out.append(f)
     if full_run:
-        out.extend(
-            _unused_suppression_findings(module.suppressions, relpath)
-        )
+        for module in modules:
+            out.extend(
+                _unused_suppression_findings(
+                    module.suppressions, module.relpath
+                )
+            )
         out.sort(key=Finding.key)
     return out

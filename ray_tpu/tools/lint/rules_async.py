@@ -67,6 +67,25 @@ class AsyncBlockingCallRule(Rule):
         "blocking call (ray_tpu.get / Future.result / time.sleep / "
         "lock.acquire / Event.wait) inside async def stalls the event loop"
     )
+    rationale = (
+        "a coroutine runs on the actor's single event loop; one blocking "
+        "call stalls EVERY in-flight request on that actor, and when the "
+        "awaited result depends on another task of the same actor it "
+        "deadlocks outright. Ship blocking work off-loop with "
+        "run_in_executor/to_thread, or use the async variant."
+    )
+    bad_example = """
+        import ray_tpu
+
+        async def handler(ref):
+            return ray_tpu.get(ref)
+    """
+    good_example = """
+        import asyncio
+
+        async def handler(loop, ref):
+            return await loop.run_in_executor(None, fetch, ref)
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
@@ -154,6 +173,35 @@ class AwaitHoldingLockRule(Rule):
         "await while holding a threading lock parks the lock across a "
         "suspension point — any contender deadlocks the loop"
     )
+    rationale = (
+        "the suspended coroutine keeps the OS lock; any thread — or any "
+        "coroutine on this loop that needs the same lock before the "
+        "holder resumes — blocks the whole event loop. Use an asyncio "
+        "lock, or release before awaiting."
+    )
+    bad_example = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self, coro):
+                with self._lock:
+                    await coro
+    """
+    good_example = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def good(self, coro):
+                with self._lock:
+                    pass
+                await coro
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []

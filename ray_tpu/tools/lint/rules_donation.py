@@ -1,0 +1,1067 @@
+"""Family 5 — buffer donation and JAX-performance rules.
+
+RTL501: use-after-donate. `jax.jit(fn, donate_argnums=...)` hands the
+argument buffers to XLA — after the call the caller's array is DELETED
+(reads raise on TPU, or silently alias garbage under some backends).
+The only safe shape is the functional thread: pass the buffer in, bind
+the returned replacement, never touch the old name again. The check is
+flow-sensitive within the caller: a read of a donated name/attr after
+the donating call (including the next iteration of an enclosing loop
+when nothing rebinds it) is a finding; rebinding first is the fix.
+
+RTL502: unstable jit signature — the retrace-storm family. Three shapes:
+a jit wrapper created fresh per call around a fresh function object
+(lambda / `functools.partial` / nested def) and invoked locally — the
+compile cache is keyed on the function object, so EVERY call recompiles;
+an unhashable or identity-hashed object (list/dict/set literal,
+non-frozen dataclass, plain class without `__eq__`/`__hash__` — resolved
+through the project symbol table) in a static-arg position; and a
+`len()`-derived Python value flowing into an array shape that feeds a
+jitted program without passing a bucketing helper — every distinct
+length compiles a new program.
+
+RTL503: host-device sync inside a step loop. `.item()`, `float()`,
+`np.asarray()`, `jax.device_get()` or `block_until_ready` on a value a
+jitted program produced in the SAME loop stalls the pipeline every
+iteration: the host waits for the device instead of queueing the next
+step. Move the sync after the loop (or keep per-step results on device).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    _param_names,
+    _resolve_function,
+    _scope_level_nodes,
+    _target_binds,
+    call_kwargs,
+)
+from ray_tpu.tools.lint.rules_trace import (
+    _decorator_jit_desc,
+    _is_jit_wrapper,
+)
+
+# jit wrappers whose kwargs carry donation/static info (pallas_call and
+# shard_map don't donate).
+_DONATING_WRAPPERS = ("jit", "pjit")
+
+ARRAY_CTOR_LASTS = {"zeros", "ones", "full", "empty"}
+ARRAY_CTOR_ROOTS = ("numpy", "jax.numpy")
+
+SYNC_CALLS = {"float", "int"}
+
+
+def _sync_dotted(dotted: Optional[str]) -> bool:
+    """Dotted call target that forces a device->host transfer. asarray/
+    array only sync under a NUMPY root — jnp.asarray of a device array
+    is a device op, not a host read."""
+    if dotted is None:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    if last in ("asarray", "array"):
+        return dotted.startswith("numpy.")
+    return last in ("device_get", "block_until_ready")
+
+
+@dataclasses.dataclass
+class JitBinding:
+    """One name bound to a jit-wrapped callable."""
+
+    fn: Optional[ast.AST]  # resolved wrapped function, when local
+    call: Optional[ast.Call]  # the jax.jit(...) call (None for decorators)
+    desc: str
+    donated: Optional[frozenset] = None  # positions; None = none/unknown
+    static: frozenset = frozenset()  # static positions
+    static_names: frozenset = frozenset()
+    scope_id: Optional[int] = None  # owning scope for local bindings
+
+
+def _const_positions(expr: ast.AST) -> Optional[frozenset]:
+    """donate_argnums/static_argnums value -> positions, if constant."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return frozenset({expr.value})
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for el in expr.elts:
+            if not (
+                isinstance(el, ast.Constant) and isinstance(el.value, int)
+            ):
+                return None
+            out.add(el.value)
+        return frozenset(out)
+    return None
+
+
+def _const_names(expr: ast.AST) -> Optional[frozenset]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return frozenset({expr.value})
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for el in expr.elts:
+            if not (
+                isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ):
+                return None
+            out.add(el.value)
+        return frozenset(out)
+    return None
+
+
+def _names_to_positions(
+    names: frozenset, fn: Optional[ast.AST], bound_method: bool
+) -> Optional[frozenset]:
+    """Map donate_argnames/static_argnames to positions via the wrapped
+    function's parameter list (minus `self` when the function was handed
+    in bound, e.g. `jax.jit(self._step, donate_argnames=...)`)."""
+    if fn is None or isinstance(fn, ast.Lambda):
+        return None
+    params = [p.arg for p in fn.args.args]
+    if bound_method and params and params[0] in ("self", "cls"):
+        params = params[1:]
+    out = set()
+    for n in names:
+        if n not in params:
+            return None
+        out.add(params.index(n))
+    return frozenset(out)
+
+
+def _is_donating_wrapper(module: ModuleInfo, func: ast.AST) -> bool:
+    if not _is_jit_wrapper(module, func):
+        return False
+    dotted = module.dotted_name(func)
+    return dotted is not None and (
+        dotted.rsplit(".", 1)[-1] in _DONATING_WRAPPERS
+    )
+
+
+def _binding_from_wrapper_call(
+    module: ModuleInfo, call: ast.AST
+) -> Optional[JitBinding]:
+    """Inspect a jax.jit/pjit call's kwargs for donation/static info."""
+    if isinstance(call, ast.IfExp):
+        # `self._fn = jax.jit(...) if has_head else None` — either arm
+        # may be the wrapper (the None arm contributes nothing).
+        return _binding_from_wrapper_call(
+            module, call.body
+        ) or _binding_from_wrapper_call(module, call.orelse)
+    if not isinstance(call, ast.Call):
+        return None
+    if not _is_donating_wrapper(module, call.func):
+        return None
+    if not call.args:
+        return None
+    fn_expr = call.args[0]
+    fn = _resolve_function(module, fn_expr, call)
+    bound_method = (
+        isinstance(fn_expr, ast.Attribute)
+        and isinstance(fn_expr.value, ast.Name)
+        and fn_expr.value.id == "self"
+    )
+    kw = call_kwargs(call)
+    donated: Optional[frozenset] = None
+    if "donate_argnums" in kw:
+        donated = _const_positions(kw["donate_argnums"])
+    elif "donate_argnames" in kw:
+        names = _const_names(kw["donate_argnames"])
+        if names is not None:
+            donated = _names_to_positions(names, fn, bound_method)
+    static = frozenset()
+    static_names = frozenset()
+    if "static_argnums" in kw:
+        static = _const_positions(kw["static_argnums"]) or frozenset()
+    if "static_argnames" in kw:
+        static_names = _const_names(kw["static_argnames"]) or frozenset()
+        mapped = _names_to_positions(static_names, fn, bound_method)
+        if mapped is not None:
+            static = static | mapped
+    return JitBinding(
+        fn=fn,
+        call=call,
+        desc=module.dotted_name(call.func) or "jit",
+        donated=donated,
+        static=static,
+        static_names=static_names,
+    )
+
+
+def _owning_scope(module: ModuleInfo, node: ast.AST) -> ast.AST:
+    cur = module.parent(node)
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return cur
+        cur = module.parent(cur)
+    return module.tree
+
+
+def _enclosing_class(module: ModuleInfo, node: ast.AST):
+    cur = module.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = module.parent(cur)
+    return None
+
+
+def jitted_bindings(module: ModuleInfo):
+    """Registry of names bound to jit-wrapped callables, memoized.
+
+    Returns (attr_bindings, local_bindings, def_bindings):
+      attr_bindings:  (class id, attr) -> JitBinding (self._fn = jax.jit(...);
+                      keyed PER CLASS — two classes may both use `_fn`)
+      local_bindings: name -> [JitBinding with scope_id]  (fn = jax.jit(...))
+      def_bindings:   def name         -> JitBinding (decorated defs)
+    """
+    cached = module.memo.get("jit_bindings")
+    if cached is not None:
+        return cached
+    attr: Dict[tuple, JitBinding] = {}
+    local: Dict[str, List[JitBinding]] = {}
+    defs: Dict[str, JitBinding] = {}
+    for node in module.nodes(ast.Assign):
+        binding = _binding_from_wrapper_call(module, node.value)
+        if binding is None:
+            continue
+        cls = _enclosing_class(module, node)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                binding.scope_id = id(_owning_scope(module, node))
+                local.setdefault(t.id, []).append(binding)
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and cls is not None
+            ):
+                attr[(id(cls), t.attr)] = binding
+    for node in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        for dec in node.decorator_list:
+            desc = _decorator_jit_desc(module, dec)
+            if not desc:
+                continue
+            # Kwargs live on @jax.jit(...) or @partial(jax.jit, ...).
+            kw_call = dec if isinstance(dec, ast.Call) else None
+            binding = JitBinding(fn=node, call=kw_call, desc=desc)
+            in_class = isinstance(module.parent(node), ast.ClassDef)
+            if kw_call is not None:
+                kw = call_kwargs(kw_call)
+                if "donate_argnums" in kw:
+                    binding.donated = _const_positions(kw["donate_argnums"])
+                elif "donate_argnames" in kw:
+                    names = _const_names(kw["donate_argnames"])
+                    if names is not None:
+                        binding.donated = _names_to_positions(
+                            names, node, in_class
+                        )
+                        if in_class and binding.donated is not None:
+                            # _names_to_positions already dropped `self`;
+                            # re-base below expects self-inclusive indexes.
+                            binding.donated = frozenset(
+                                p + 1 for p in binding.donated
+                            )
+                if "static_argnums" in kw:
+                    binding.static = (
+                        _const_positions(kw["static_argnums"]) or frozenset()
+                    )
+                if "static_argnames" in kw:
+                    binding.static_names = (
+                        _const_names(kw["static_argnames"]) or frozenset()
+                    )
+            if in_class:
+                # A decorated METHOD's argnums count `self` (position 0),
+                # but call sites `self.step(a, b)` pass args without it —
+                # re-base positions onto the caller's view. A position
+                # naming `self` itself can't map to any call-site arg.
+                binding = dataclasses.replace(
+                    binding,
+                    donated=(
+                        frozenset(p - 1 for p in binding.donated if p > 0)
+                        if binding.donated is not None
+                        else None
+                    ),
+                    static=frozenset(
+                        p - 1 for p in binding.static if p > 0
+                    ),
+                )
+                cls = _enclosing_class(module, node)
+                attr.setdefault((id(cls), node.name), binding)
+            else:
+                defs[node.name] = binding
+    out = (attr, local, defs)
+    module.memo["jit_bindings"] = out
+    return out
+
+
+def _binding_for_call(
+    module: ModuleInfo, call: ast.Call
+) -> Optional[JitBinding]:
+    """The JitBinding a call site dispatches to, when resolvable."""
+    attr, local, defs = jitted_bindings(module)
+    func = call.func
+    if isinstance(func, ast.Call):
+        return _binding_from_wrapper_call(module, func)
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        cls = _enclosing_class(module, call)
+        if cls is None:
+            return None
+        # Walk the (statically resolvable) base-class chain: a subclass
+        # method calling `self._split_fn` set up in the parent __init__
+        # must see the parent's binding.
+        seen = set()
+        stack = [(module, cls)]
+        while stack:
+            cmod, cnode = stack.pop()
+            if id(cnode) in seen:
+                continue
+            seen.add(id(cnode))
+            cattr, _, _ = jitted_bindings(cmod)
+            binding = cattr.get((id(cnode), func.attr))
+            if binding is not None:
+                return binding
+            project = cmod.project
+            for base in cnode.bases:
+                resolved = None
+                if project is not None:
+                    sym = project.resolve_expr(cmod, base)
+                    if sym is not None and isinstance(
+                        sym.node, ast.ClassDef
+                    ):
+                        resolved = (sym.module, sym.node)
+                if resolved is not None:
+                    stack.append(resolved)
+        return None
+    if isinstance(func, ast.Name):
+        candidates = local.get(func.id)
+        if candidates:
+            scope = module.parent(call)
+            scope_ids = set()
+            while scope is not None:
+                scope_ids.add(id(scope))
+                scope = module.parent(scope)
+            scope_ids.add(id(module.tree))
+            for b in candidates:
+                if b.scope_id in scope_ids:
+                    return b
+        return defs.get(func.id)
+    return None
+
+
+def _enclosing_stmt(module: ModuleInfo, node: ast.AST) -> ast.stmt:
+    cur = node
+    while not isinstance(cur, ast.stmt):
+        cur = module.parent(cur)
+    return cur
+
+
+def _enclosing_loop(
+    module: ModuleInfo, node: ast.AST, stop: ast.AST
+) -> Optional[ast.AST]:
+    cur = module.parent(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            return cur
+        cur = module.parent(cur)
+    return None
+
+
+# ---------------------------------------------------------------------------
+
+
+class UseAfterDonateRule(Rule):
+    id = "RTL501"
+    name = "use-after-donate"
+    family = "donation"
+    description = (
+        "buffer passed in a donate_argnums position is read after the "
+        "call — the donated array no longer exists"
+    )
+    rationale = (
+        "donate_argnums hands the argument's device buffer to XLA for "
+        "in-place reuse; after the call the old array is deleted. A later "
+        "read raises RuntimeError on TPU (or aliases reused memory). "
+        "Thread the buffer functionally: rebind the name to the returned "
+        "replacement before any further use — including the next "
+        "iteration of a loop."
+    )
+    bad_example = """
+        import jax
+
+        def make_step(fn):
+            return jax.jit(fn, donate_argnums=(0,))
+
+        def train(params, batch, fn):
+            step = jax.jit(fn, donate_argnums=(0,))
+            new_params, loss = step(params, batch)
+            norm = jax.numpy.linalg.norm(params)  # donated buffer
+            return new_params, loss, norm
+    """
+    good_example = """
+        import jax
+
+        def train(params, batch, fn):
+            step = jax.jit(fn, donate_argnums=(0,))
+            params, loss = step(params, batch)
+            norm = jax.numpy.linalg.norm(params)  # the NEW buffer
+            return params, loss, norm
+    """
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for call in module.nodes(ast.Call):
+            binding = _binding_for_call(module, call)
+            if binding is None or not binding.donated:
+                continue
+            scope = _owning_scope(module, call)
+            if scope is module.tree or isinstance(scope, ast.Lambda):
+                continue
+            for pos, arg in self._donated_args(call, binding):
+                dotted = module.dotted_name(arg)
+                if dotted is None:
+                    continue
+                read = self._read_after(module, scope, call, dotted)
+                if read is not None:
+                    out.append(
+                        self.finding(
+                            module,
+                            read,
+                            f"`{dotted}` was donated to {binding.desc}-"
+                            f"compiled callee (arg {pos}) and read here "
+                            "afterwards; the buffer no longer exists — "
+                            "rebind the name to the returned replacement "
+                            "first",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _donated_args(call: ast.Call, binding: JitBinding):
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions past a splat are unknowable
+            if i in binding.donated:
+                yield i, arg
+
+    def _read_after(
+        self, module: ModuleInfo, scope: ast.AST, call: ast.Call, dotted: str
+    ) -> Optional[ast.AST]:
+        """First use of `dotted` after the donating call: a Load node
+        when the donated buffer is read, None when it is rebound first
+        (or never touched). An enclosing loop wraps around: with no
+        rebind in the loop body, the call's own next-iteration read is
+        the read-after-donate."""
+        call_nodes = {id(n) for n in ast.walk(call)}
+        stmt = _enclosing_stmt(module, call)
+        stmt_end = getattr(stmt, "end_lineno", stmt.lineno)
+        loop = _enclosing_loop(module, call, scope)
+
+        occs: List[Tuple[int, int, bool, ast.AST]] = []
+        for node in _scope_level_nodes(scope):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if module.dotted_name(node) != dotted:
+                continue
+            is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+            if id(node) in call_nodes and not is_store:
+                continue  # the donation read itself
+            occs.append((node.lineno, node.col_offset, is_store, node))
+
+        same_loads = sorted(
+            o for o in occs
+            if stmt.lineno <= o[0] <= stmt_end and not o[2]
+        )
+        same_stores = sorted(
+            o for o in occs if stmt.lineno <= o[0] <= stmt_end and o[2]
+        )
+        after = sorted(o for o in occs if o[0] > stmt_end)
+        sequence = same_loads + same_stores + after
+        if loop is not None:
+            loop_end = getattr(loop, "end_lineno", loop.lineno)
+            wrapped = sorted(
+                o for o in occs
+                if loop.lineno <= o[0] < stmt.lineno and o[0] <= loop_end
+            )
+            # The donating call re-reads the name on the next iteration.
+            sequence = sequence + wrapped + [
+                (call.lineno, call.col_offset, False, call)
+            ]
+        for _, _, is_store, node in sequence:
+            if is_store:
+                return None
+            return node
+        return None
+
+
+class UnstableJitSignatureRule(Rule):
+    id = "RTL502"
+    name = "unstable-jit-signature"
+    family = "donation"
+    description = (
+        "jit signature changes every call (fresh function object, "
+        "unhashable/identity-hashed static arg, or unbucketed dynamic "
+        "shape) — each call recompiles"
+    )
+    rationale = (
+        "jax caches compiled programs per (function object, static args, "
+        "input shapes). A lambda/partial/nested def re-jitted per call, a "
+        "static arg whose hash changes per call (lists are a TypeError; "
+        "default-__eq__ objects never compare equal), or a len()-derived "
+        "array shape that skips the bucketing helpers all defeat the "
+        "cache: silent recompilation on every step — the retrace storm."
+    )
+    bad_example = """
+        import jax
+
+        def update(params, grads):
+            step = jax.jit(lambda p, g: jax.tree_util.tree_map(
+                lambda a, b: a - 0.1 * b, p, g))
+            return step(params, grads)
+    """
+    good_example = """
+        import jax
+
+        def _step(p, g):
+            return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+        _jitted_step = jax.jit(_step)
+
+        def update(params, grads):
+            return _jitted_step(params, grads)
+    """
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        out.extend(self._fresh_jit_in_hot_path(module))
+        out.extend(self._unstable_static_args(module))
+        out.extend(self._unbucketed_shapes(module))
+        return out
+
+    # -- (a) fresh function object jitted per call --------------------------
+
+    def _fresh_jit_in_hot_path(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for call in module.nodes(ast.Call):
+            if not _is_donating_wrapper(module, call.func):
+                continue
+            scope = _owning_scope(module, call)
+            if scope is module.tree or isinstance(scope, ast.Lambda):
+                continue  # module-level jit compiles once per import
+            if not call.args:
+                continue
+            if not self._fn_arg_is_fresh(module, call.args[0], call, scope):
+                continue
+            usage = self._result_usage(module, call, scope)
+            if usage == "called":
+                out.append(
+                    self.finding(
+                        module,
+                        call,
+                        "jit of a fresh function object created and "
+                        f"called inside `{getattr(scope, 'name', '?')}` — "
+                        "the compile cache keys on the function object, "
+                        "so every call recompiles; hoist the jit (or "
+                        "cache it on self)",
+                    )
+                )
+        return out
+
+    def _fn_arg_is_fresh(
+        self, module: ModuleInfo, arg: ast.AST, call: ast.Call, scope
+    ) -> bool:
+        """Is the wrapped function a NEW object per execution of `scope`?
+        Lambdas, partial(...) built here, and defs nested in this scope
+        are; module-level defs and methods are stable."""
+        if isinstance(arg, ast.Lambda):
+            return True
+        if isinstance(arg, ast.Call):
+            dotted = module.dotted_name(arg.func)
+            return bool(
+                dotted and dotted.rsplit(".", 1)[-1] == "partial"
+            )
+        fn = _resolve_function(module, arg, call)
+        if fn is None or isinstance(fn, ast.Lambda):
+            return isinstance(fn, ast.Lambda)
+        owner = _owning_scope(module, fn)
+        return owner is scope
+
+    def _result_usage(
+        self, module: ModuleInfo, call: ast.Call, scope
+    ) -> str:
+        """'called' when the jit result is only invoked locally;
+        'escapes' when it is returned / stored / passed on (a factory or
+        a build-once pattern — compiles once, fine)."""
+        parent = module.parent(call)
+        if isinstance(parent, ast.Call) and parent.func is call:
+            return "called"  # jax.jit(f)(x)
+        stmt = _enclosing_stmt(module, call)
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            names = [
+                t.id for t in stmt.targets if isinstance(t, ast.Name)
+            ]
+            if len(names) != len(stmt.targets) or not names:
+                return "escapes"  # stored to an attribute/subscript
+            name = names[0]
+            called_only = False
+            for node in _scope_level_nodes(scope):
+                if not isinstance(node, ast.Name) or node.id != name:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    continue
+                use_parent = module.parent(node)
+                if isinstance(
+                    use_parent, ast.Call
+                ) and use_parent.func is node:
+                    called_only = True
+                    continue
+                return "escapes"  # returned, passed, stored elsewhere
+            return "called" if called_only else "escapes"
+        return "escapes"
+
+    # -- (b) unhashable / identity-hashed static args -----------------------
+
+    def _unstable_static_args(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for call in module.nodes(ast.Call):
+            binding = _binding_for_call(module, call)
+            if binding is None:
+                continue
+            if not binding.static and not binding.static_names:
+                continue
+            checked: List[Tuple[ast.AST, str]] = []
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    break
+                if i in binding.static:
+                    checked.append((arg, f"static arg {i}"))
+            for kw in call.keywords:
+                if kw.arg and kw.arg in binding.static_names:
+                    checked.append((kw.value, f"static arg {kw.arg!r}"))
+            for arg, where in checked:
+                label = self._unstable_label(module, arg)
+                if label is not None:
+                    out.append(
+                        self.finding(
+                            module,
+                            arg,
+                            f"{label} in {where} of a {binding.desc}-"
+                            "compiled call: static args key the compile "
+                            "cache by hash/equality, so this recompiles "
+                            "(or raises) on every call",
+                        )
+                    )
+        return out
+
+    def _unstable_label(
+        self, module: ModuleInfo, arg: ast.AST
+    ) -> Optional[str]:
+        if isinstance(arg, (ast.List, ast.ListComp)):
+            return "unhashable list"
+        if isinstance(arg, (ast.Dict, ast.DictComp)):
+            return "unhashable dict"
+        if isinstance(arg, (ast.Set, ast.SetComp)):
+            return "unhashable set"
+        if isinstance(arg, ast.Lambda):
+            return "fresh lambda (identity-hashed)"
+        if not isinstance(arg, ast.Call):
+            return None
+        dotted = module.dotted_name(arg.func)
+        if dotted in ("dict", "list", "set"):
+            return f"unhashable {dotted}"
+        project = module.project
+        if project is None:
+            return None
+        sym = project.resolve_expr(module, arg.func)
+        if sym is None or not isinstance(sym.node, ast.ClassDef):
+            return None
+        return self._class_instability(sym.module, sym.node)
+
+    @staticmethod
+    def _class_instability(
+        clsmod: ModuleInfo, cls: ast.ClassDef
+    ) -> Optional[str]:
+        members = {
+            n.name
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if "__eq__" in members and "__hash__" in members:
+            return None  # value semantics: stable cache key
+        for dec in cls.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = clsmod.dotted_name(target) or ""
+            if dotted.rsplit(".", 1)[-1] == "dataclass":
+                frozen = isinstance(dec, ast.Call) and any(
+                    kw.arg == "frozen"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in dec.keywords
+                )
+                if frozen:
+                    return None  # eq + hash generated
+                return (
+                    f"non-frozen dataclass {cls.name} (defines __eq__ "
+                    "but __hash__ is None — unhashable)"
+                )
+        if "__eq__" in members:
+            return (
+                f"{cls.name} instance (defines __eq__ without __hash__ "
+                "— unhashable)"
+            )
+        return (
+            f"fresh {cls.name} instance (default identity hash — never "
+            "equal to the previous call's)"
+        )
+
+    # -- (c) unbucketed dynamic shapes ---------------------------------------
+
+    def _unbucketed_shapes(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in module.scopes:
+            if scope is module.tree or isinstance(scope, ast.Lambda):
+                continue
+            jit_calls = [
+                n
+                for n in _scope_level_nodes(scope)
+                if isinstance(n, ast.Call)
+                and _binding_for_call(module, n) is not None
+            ]
+            if not jit_calls:
+                continue
+            tainted = self._len_tainted_names(module, scope)
+            if not tainted:
+                continue
+            dynamic = self._dynamic_arrays(module, scope, tainted)
+            if not dynamic:
+                continue
+            for call in jit_calls:
+                for arg in call.args:
+                    hit = self._references_dynamic(module, arg, dynamic)
+                    if hit is not None:
+                        name, ctor = hit
+                        out.append(
+                            self.finding(
+                                module,
+                                ctor,
+                                f"array `{name}` is shaped by a len()-"
+                                "derived value and fed to a jit-compiled "
+                                "call — every distinct length compiles a "
+                                "new program; round the size through a "
+                                "bucketing helper first",
+                            )
+                        )
+        return out
+
+    def _len_tainted_names(self, module: ModuleInfo, scope) -> Set[str]:
+        """Names whose value derives from len(...) without passing a
+        bucketing helper (any call whose name mentions 'bucket'
+        sanitizes)."""
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in _scope_level_nodes(scope):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._expr_tainted(module, node.value, tainted):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+        return tainted
+
+    def _expr_tainted(
+        self, module: ModuleInfo, expr: ast.AST, tainted: Set[str]
+    ) -> bool:
+        if isinstance(expr, ast.Call):
+            dotted = module.dotted_name(expr.func) or ""
+            if "bucket" in dotted.rsplit(".", 1)[-1].lower():
+                return False  # sanitized
+            if dotted == "len":
+                return True
+            return any(
+                self._expr_tainted(module, a, tainted) for a in expr.args
+            )
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.BinOp):
+            return self._expr_tainted(
+                module, expr.left, tainted
+            ) or self._expr_tainted(module, expr.right, tainted)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(
+                self._expr_tainted(module, el, tainted) for el in expr.elts
+            )
+        return False
+
+    def _dynamic_arrays(
+        self, module: ModuleInfo, scope, tainted: Set[str]
+    ) -> Dict[str, ast.AST]:
+        """name -> ctor node for arrays whose shape mentions a tainted
+        value (np.zeros((1, n), ...) with n len-derived)."""
+        out: Dict[str, ast.AST] = {}
+        for node in _scope_level_nodes(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            ctor = node.value
+            if not isinstance(ctor, ast.Call):
+                continue
+            dotted = module.dotted_name(ctor.func) or ""
+            if dotted.rsplit(".", 1)[-1] not in ARRAY_CTOR_LASTS:
+                continue
+            if not dotted.startswith(ARRAY_CTOR_ROOTS):
+                continue
+            shape = ctor.args[0] if ctor.args else None
+            for kw in ctor.keywords:
+                if kw.arg == "shape":
+                    shape = kw.value
+            if shape is None:
+                continue
+            if self._expr_tainted(module, shape, tainted):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = ctor
+        return out
+
+    def _references_dynamic(
+        self, module: ModuleInfo, arg: ast.AST, dynamic: Dict[str, ast.AST]
+    ) -> Optional[Tuple[str, ast.AST]]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and node.id in dynamic:
+                return (node.id, dynamic[node.id])
+        return None
+
+
+class HostSyncInStepLoopRule(Rule):
+    id = "RTL503"
+    name = "host-sync-in-step-loop"
+    family = "donation"
+    description = (
+        "host-device sync (.item()/float()/np.asarray/device_get/"
+        "block_until_ready) on a jitted result inside the step loop "
+        "stalls the pipeline every iteration"
+    )
+    rationale = (
+        "jax dispatch is async: a loop that launches a jitted step and "
+        "immediately syncs its result ( .item(), float(), np.asarray, "
+        "device_get, block_until_ready ) serializes host and device — "
+        "the device idles while the host reads, every single iteration. "
+        "Keep per-step values on device and sync once after the loop."
+    )
+    bad_example = """
+        import jax
+        import numpy as np
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            losses = []
+            for batch in batches:
+                params, loss = step(params, batch)
+                losses.append(float(loss))  # sync every iteration
+            return params, losses
+    """
+    good_example = """
+        import jax
+        import numpy as np
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            losses = []
+            for batch in batches:
+                params, loss = step(params, batch)
+                losses.append(loss)  # device values accumulate async
+            return params, [float(x) for x in losses]
+    """
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        flagged: Set[int] = set()  # a sync inside nested loops flags once
+        for loop in module.nodes(ast.For, ast.While):
+            scope = _owning_scope(module, loop)
+            if isinstance(scope, ast.Lambda):
+                continue
+            body_nodes = list(self._loop_body_nodes(loop))
+            jit_calls = [
+                n
+                for n in body_nodes
+                if isinstance(n, ast.Call)
+                and _binding_for_call(module, n) is not None
+            ]
+            if not jit_calls:
+                continue
+            tainted = self._jit_result_names(module, body_nodes, jit_calls)
+            for node in body_nodes:
+                if not isinstance(node, ast.Call) or id(node) in flagged:
+                    continue
+                label = self._sync_label(module, node, tainted, jit_calls)
+                if label is None:
+                    continue
+                flagged.add(id(node))
+                out.append(
+                    self.finding(
+                        module,
+                        node,
+                        f"{label} inside a loop that also runs a jitted "
+                        "step forces a host-device sync every iteration; "
+                        "accumulate on device and sync after the loop",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _loop_body_nodes(loop):
+        """All nodes in the loop body, not descending into nested
+        function definitions."""
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _jit_result_names(
+        self, module, body_nodes, jit_calls
+    ) -> Set[str]:
+        """Names carrying a jitted call's result in the loop body —
+        assignment targets (tuple unpack included), plus `for k, v in
+        fwd.items()` targets and comprehension generators iterating a
+        tainted value. Fixed point so chains propagate regardless of
+        statement order."""
+        jit_ids = {id(c) for c in jit_calls}
+        tainted: Set[str] = set()
+
+        def expr_tainted(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if id(n) in jit_ids:
+                    return True
+                if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, ast.Load
+                ) and n.id in tainted:
+                    return True
+            return False
+
+        def add_targets(target: ast.AST) -> bool:
+            added = False
+            for sub in ast.walk(target):
+                # Store-context Names only: in `self._rng = step(...)`
+                # the Name `self` is a Load inside an Attribute store
+                # and must not taint every later `self.x` expression.
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Store
+                ) and sub.id not in tainted:
+                    tainted.add(sub.id)
+                    added = True
+            return added
+
+        changed = True
+        while changed:
+            changed = False
+            for node in body_nodes:
+                if isinstance(node, ast.Assign):
+                    # A sync call's RESULT is host data: `actions =
+                    # np.asarray(fwd[...])` must not taint the env-step
+                    # outputs computed from it downstream.
+                    if self._is_sync_shaped(module, node.value):
+                        continue
+                    if expr_tainted(node.value):
+                        for t in node.targets:
+                            changed |= add_targets(t)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if expr_tainted(node.iter):
+                        changed |= add_targets(node.target)
+                elif isinstance(
+                    node,
+                    (ast.ListComp, ast.SetComp, ast.DictComp,
+                     ast.GeneratorExp),
+                ):
+                    for gen in node.generators:
+                        if expr_tainted(gen.iter):
+                            changed |= add_targets(gen.target)
+        return tainted
+
+    @staticmethod
+    def _is_sync_shaped(module, expr: ast.AST) -> bool:
+        """Structurally a host-sync call (float/int/np.asarray/.item/
+        device_get/...), regardless of what it is applied to. A
+        comprehension whose element is a sync produces host data too
+        (`{k: np.asarray(v) for k, v in fwd.items()}`)."""
+        if isinstance(expr, ast.DictComp):
+            return HostSyncInStepLoopRule._is_sync_shaped(
+                module, expr.value
+            )
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return HostSyncInStepLoopRule._is_sync_shaped(module, expr.elt)
+        if not isinstance(expr, ast.Call):
+            return False
+        func = expr.func
+        if isinstance(func, ast.Attribute) and func.attr in (
+            "item", "block_until_ready"
+        ):
+            return True
+        dotted = module.dotted_name(func)
+        if dotted in SYNC_CALLS:
+            return True
+        return _sync_dotted(dotted)
+
+    def _sync_label(
+        self, module, call: ast.Call, tainted: Set[str], jit_calls
+    ) -> Optional[str]:
+        func = call.func
+        jit_ids = {id(c) for c in jit_calls}
+
+        def arg_is_device_value() -> bool:
+            for a in call.args:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name) and n.id in tainted:
+                        return True
+                    if id(n) in jit_ids:
+                        return True
+            return False
+
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "item"
+            and not call.args
+        ):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id in tainted:
+                return f"{recv.id}.item()"
+            if id(recv) in jit_ids:
+                return ".item() on the step result"
+            return None
+        dotted = module.dotted_name(func)
+        if dotted in SYNC_CALLS and arg_is_device_value():
+            return f"{dotted}() on a jitted result"
+        if _sync_dotted(dotted):
+            if dotted.rsplit(".", 1)[-1] == "block_until_ready":
+                return f"{dotted}()"
+            if arg_is_device_value():
+                return f"{dotted}() on a jitted result"
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "block_until_ready"
+        ):
+            recv = func.value
+            if (
+                isinstance(recv, ast.Name) and recv.id in tainted
+            ) or id(recv) in jit_ids:
+                return ".block_until_ready()"
+        return None
+
+
+RULES = [UseAfterDonateRule, UnstableJitSignatureRule, HostSyncInStepLoopRule]

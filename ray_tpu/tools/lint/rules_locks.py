@@ -116,6 +116,44 @@ class LockCoverageRule(Rule):
         "attribute mutated under a lock in one method must not be "
         "read or written without it in another"
     )
+    rationale = (
+        "the class's own locking discipline defines which attributes are "
+        "shared state: anything mutated under self._lock is contended, so "
+        "a bare access elsewhere races the locked writers — torn reads, "
+        "lost updates, check-then-act bugs. __init__, *_locked helpers "
+        "and 'Caller must hold' docstrings are exempt."
+    )
+    bad_example = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                return len(self._items)
+    """
+    good_example = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                with self._lock:
+                    return len(self._items)
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
@@ -359,6 +397,34 @@ class ManualAcquireRule(Rule):
         "lock.acquire() outside a with-statement leaks the lock if "
         "anything between acquire and release raises"
     )
+    rationale = (
+        "an exception between acquire() and release() leaves the lock "
+        "held forever — every later contender hangs. The with-statement "
+        "releases on every exit path."
+    )
+    bad_example = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                do_something()
+                self._lock.release()
+    """
+    good_example = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def good(self):
+                with self._lock:
+                    do_something()
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []

@@ -36,6 +36,21 @@ class DroppedObjectRefRule(Rule):
         "bare .remote(...) statement discards the ObjectRef: the result "
         "and any error become unobservable"
     )
+    rationale = (
+        "a dropped ObjectRef means the task's failure is silently "
+        "swallowed and its result is immediately eligible for "
+        "reclamation. Bind the ref (even to collect later) so errors "
+        "surface and lifetimes are explicit."
+    )
+    bad_example = """
+        def fire(handle):
+            handle.ping.remote()
+    """
+    good_example = """
+        def keep(handle):
+            ref = handle.ping.remote()
+            return ref
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
@@ -66,6 +81,27 @@ class UnawaitedCoroutineRule(Rule):
         "calling a local async def without await creates a coroutine "
         "that never runs"
     )
+    rationale = (
+        "the call builds a coroutine object and throws it away — the "
+        "body never executes, and Python only murmurs a 'never awaited' "
+        "warning at GC time, far from the bug."
+    )
+    bad_example = """
+        class A:
+            async def _push(self):
+                pass
+
+            def kick(self):
+                self._push()
+    """
+    good_example = """
+        class A:
+            async def _push(self):
+                pass
+
+            async def kick(self):
+                await self._push()
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         module_async: Set[str] = set()
@@ -123,6 +159,28 @@ class ClearedBeforeCommitRule(Rule):
         "rollback marker set to None before the operation consuming it "
         "completed; an exception in between leaks the resource"
     )
+    rationale = (
+        "clearing the marker first removes the only record a failure "
+        "handler could roll back with: if the consuming operation "
+        "raises, the resource (a KV block, a pinned ref) leaks forever. "
+        "Commit first, clear after."
+    )
+    bad_example = """
+        class Engine:
+            def bad(self, seq):
+                src, dst = seq.pending_copy
+                seq.pending_copy = None
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])
+    """
+    good_example = """
+        class Engine:
+            def good(self, seq):
+                src, dst = seq.pending_copy
+                self.runner.copy_block(src, dst)
+                self.allocator.free([src])
+                seq.pending_copy = None
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
@@ -203,6 +261,28 @@ class LeakyAcquireRule(Rule):
         "allocate()/touch() outside try with a later free() in the same "
         "function: a raise in between leaks the acquired references"
     )
+    rationale = (
+        "the function clearly owns the resource (it frees it later), "
+        "but any exception between acquire and free skips the release — "
+        "refcounts drift up and the pool shrinks permanently. Wrap the "
+        "consuming work in try/finally."
+    )
+    bad_example = """
+        class S:
+            def bad(self, n):
+                blocks = self.allocator.allocate(n)
+                self.compute(blocks)
+                self.allocator.free(blocks)
+    """
+    good_example = """
+        class S:
+            def good(self, n):
+                blocks = self.allocator.allocate(n)
+                try:
+                    self.compute(blocks)
+                finally:
+                    self.allocator.free(blocks)
+    """
 
     ACQUIRERS = {"allocate", "touch"}
     RELEASERS = {"free", "release"}

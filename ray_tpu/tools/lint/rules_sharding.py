@@ -1,0 +1,506 @@
+"""Family 6 — sharding-consistency rules.
+
+RTL601: a `shard_map` (or `NamedSharding`) whose PartitionSpecs name an
+axis the mesh at the call site does not have. jax raises at trace time
+in the lucky case; with `check_vma=False` (this repo's default through
+the compat shim) a misspelled axis can silently mean "replicated",
+producing wrong-but-plausible numerics at mesh scale. The mesh's axis
+names resolve statically through the project symbol table: a literal
+`Mesh(devs, ("dp", "tp"))`, a constant tuple imported from another
+module (`AXIS_ORDER` in ray_tpu/parallel/mesh.py), or a helper whose
+return is one of those — `MeshSpec(...).build()` included.
+
+RTL602: a collective (`lax.psum`, `ppermute`, `all_gather`,
+`axis_index`, ...) inside a shard_map/pmap body naming an axis the
+enclosing context does not bind. An unbound axis name is a trace-time
+NameError at best; at worst (axis bound by an OUTER map in some call
+paths only) a collective quietly reduces over the wrong group. Both
+rules resolve the wrapped function through `_resolve_function` across
+modules (the `ray_tpu/parallel` + `_private/jax_compat` shims look like
+plain calls at the use site).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_kwargs,
+    resolve_function_ex,
+    resolve_name_binding,
+)
+
+SHARD_WRAPPER_LASTS = ("shard_map", "pmap")
+
+# collective name -> positional index of its axis-name argument
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "ppermute": 1,
+    "all_gather": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+
+def _is_shard_wrapper(module: ModuleInfo, func: ast.AST) -> Optional[str]:
+    dotted = module.dotted_name(func)
+    if dotted is None:
+        return None
+    last = dotted.rsplit(".", 1)[-1]
+    return dotted if last in SHARD_WRAPPER_LASTS else None
+
+
+def shard_sites(module: ModuleInfo) -> List[dict]:
+    """Every shard_map/pmap application in the module, normalized:
+    {node, desc, fn_expr, kwargs, at} — from direct calls
+    (`shard_map(f, mesh=..., in_specs=...)`), partial-decorator form
+    (`@partial(shard_map, mesh=..., ...)` on a def), and plain-decorator
+    pmap. Memoized per module."""
+    cached = module.memo.get("shard_sites")
+    if cached is not None:
+        return cached
+    sites: List[dict] = []
+    for node in module.nodes(ast.Call):
+        desc = _is_shard_wrapper(module, node.func)
+        if desc is None:
+            continue
+        fn_expr = node.args[0] if node.args else None
+        kwargs = call_kwargs(node)
+        if fn_expr is None:
+            fn_expr = kwargs.get("f") or kwargs.get("fun")
+        sites.append(
+            dict(node=node, desc=desc, fn_expr=fn_expr, kwargs=kwargs,
+                 at=node, fn=None)
+        )
+    for node in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                desc = _is_shard_wrapper(module, dec)
+                if desc is not None:
+                    sites.append(
+                        dict(node=dec, desc=desc, fn_expr=None, kwargs={},
+                             at=node, fn=node)
+                    )
+                continue
+            desc = _is_shard_wrapper(module, dec.func)
+            if desc is not None:
+                sites.append(
+                    dict(node=dec, desc=desc, fn_expr=None,
+                         kwargs=call_kwargs(dec), at=node, fn=node)
+                )
+                continue
+            dotted = module.dotted_name(dec.func) or ""
+            if dotted.rsplit(".", 1)[-1] == "partial" and dec.args:
+                desc = _is_shard_wrapper(module, dec.args[0])
+                if desc is not None:
+                    sites.append(
+                        dict(node=dec, desc=desc, fn_expr=None,
+                             kwargs=call_kwargs(dec), at=node, fn=node)
+                    )
+    module.memo["shard_sites"] = sites
+    return sites
+
+
+def collect_spec_axes(
+    module: ModuleInfo, expr: Optional[ast.AST], at: ast.AST
+) -> Tuple[Set[str], bool]:
+    """Axis names appearing in a PartitionSpec expression (resolving a
+    top-level name to its binding first). Returns (axes, fully_known) —
+    fully_known is False when any spec component could not be resolved
+    to a string, so a caller must not treat the set as exhaustive."""
+    if expr is None:
+        return (set(), True)
+    if isinstance(expr, ast.Name):
+        bind = resolve_name_binding(module, expr.id, at)
+        if isinstance(bind, ast.Assign):
+            expr = bind.value
+            at = bind
+        else:
+            return (set(), False)
+    axes: Set[str] = set()
+    known = True
+    project = module.project
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = module.dotted_name(node.func)
+        if dotted is None:
+            continue
+        if dotted.rsplit(".", 1)[-1] not in ("P", "PartitionSpec"):
+            continue
+        for arg in node.args:
+            value = (
+                project.resolve_constant(module, arg, at)
+                if project is not None
+                else None
+            )
+            if value is None and isinstance(arg, ast.Constant):
+                value = arg.value
+            if value is None:
+                if not (
+                    isinstance(arg, ast.Constant) and arg.value is None
+                ):
+                    known = False
+                continue
+            for axis in value if isinstance(value, tuple) else (value,):
+                if isinstance(axis, str):
+                    axes.add(axis)
+                elif axis is not None:
+                    known = False
+    return (axes, known)
+
+
+def resolve_mesh_axes(
+    module: ModuleInfo,
+    expr: Optional[ast.AST],
+    at: ast.AST,
+    _depth: int = 0,
+) -> Optional[Tuple[str, ...]]:
+    """Statically-known axis names of a mesh expression, or None.
+
+    Handles: a literal `Mesh(devs, ("dp", "tp"))` (axes tuple possibly a
+    cross-module constant like AXIS_ORDER), a name bound to one, a call
+    to a helper function whose return is one (resolved across modules),
+    and `Spec(...).build()` where build's return constructs the Mesh."""
+    if expr is None or _depth > 6:
+        return None
+    project = module.project
+    if isinstance(expr, ast.Name):
+        bind = resolve_name_binding(module, expr.id, at)
+        if isinstance(bind, ast.Assign):
+            return resolve_mesh_axes(module, bind.value, bind, _depth + 1)
+        return None
+    if not isinstance(expr, ast.Call):
+        return None
+    dotted = module.dotted_name(expr.func)
+    if dotted is not None and dotted.rsplit(".", 1)[-1] == "Mesh":
+        axes_expr = None
+        if len(expr.args) >= 2:
+            axes_expr = expr.args[1]
+        for kw in expr.keywords:
+            if kw.arg == "axis_names":
+                axes_expr = kw.value
+        if axes_expr is None or project is None:
+            return None
+        value = project.resolve_constant(module, axes_expr, expr)
+        if isinstance(value, str):
+            return (value,)
+        if isinstance(value, tuple) and all(
+            isinstance(v, str) for v in value
+        ):
+            return value
+        return None
+    # `receiver.build()` — resolve the receiver's class, then analyze its
+    # build method's returns.
+    if (
+        isinstance(expr.func, ast.Attribute)
+        and project is not None
+    ):
+        recv = expr.func.value
+        cls = None
+        if isinstance(recv, ast.Call):
+            sym = project.resolve_expr(module, recv.func)
+            if sym is not None and isinstance(sym.node, ast.ClassDef):
+                cls = (sym.module, sym.node)
+        elif isinstance(recv, (ast.Name, ast.Attribute)):
+            if isinstance(recv, ast.Name):
+                bind = resolve_name_binding(module, recv.id, at)
+                if isinstance(bind, ast.Assign) and isinstance(
+                    bind.value, ast.Call
+                ):
+                    sym = project.resolve_expr(module, bind.value.func)
+                    if sym is not None and isinstance(
+                        sym.node, ast.ClassDef
+                    ):
+                        cls = (sym.module, sym.node)
+        if cls is not None:
+            clsmod, clsnode = cls
+            for member in clsnode.body:
+                if isinstance(
+                    member, ast.FunctionDef
+                ) and member.name == expr.func.attr:
+                    return _axes_from_returns(clsmod, member, _depth)
+        return None
+    # Plain helper call, possibly defined in another module.
+    resolved = resolve_function_ex(module, expr.func, expr)
+    if resolved is not None:
+        def_module, fn = resolved
+        if not isinstance(fn, ast.Lambda):
+            return _axes_from_returns(def_module, fn, _depth)
+    return None
+
+
+def _axes_from_returns(
+    module: ModuleInfo, fn: ast.AST, _depth: int
+) -> Optional[Tuple[str, ...]]:
+    found: Optional[Tuple[str, ...]] = None
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        axes = resolve_mesh_axes(module, node.value, node, _depth + 1)
+        if axes is None:
+            continue
+        if found is not None and found != axes:
+            return None  # ambiguous
+        found = axes
+    return found
+
+
+class SpecAxisNotInMeshRule(Rule):
+    id = "RTL601"
+    name = "spec-axis-not-in-mesh"
+    family = "sharding"
+    description = (
+        "shard_map/NamedSharding PartitionSpec names an axis the mesh at "
+        "the call site does not define"
+    )
+    rationale = (
+        "a PartitionSpec axis that isn't in the mesh raises at trace "
+        "time at best; with replication checks off (check_vma=False, the "
+        "repo default through the compat shim) a typo like 'modle' can "
+        "silently mean replicated — numerically wrong at mesh scale with "
+        "no error. Mesh axes are resolved statically (literal tuples, "
+        "cross-module constants, Spec(...).build() helpers) and the rule "
+        "only fires on proven mismatches."
+    )
+    bad_example = """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def run(fn, x, devs):
+            mesh = Mesh(devs, ("dp", "tp"))
+            f = shard_map(fn, mesh=mesh, in_specs=(P("model"),),
+                          out_specs=P("dp"))
+            return f(x)
+    """
+    good_example = """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def run(fn, x, devs):
+            mesh = Mesh(devs, ("dp", "tp"))
+            f = shard_map(fn, mesh=mesh, in_specs=(P("tp"),),
+                          out_specs=P("dp"))
+            return f(x)
+    """
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for site in shard_sites(module):
+            kwargs = site["kwargs"]
+            mesh_axes = resolve_mesh_axes(
+                module, kwargs.get("mesh"), site["at"]
+            )
+            if mesh_axes is None:
+                continue
+            spec_axes: Set[str] = set()
+            for key in ("in_specs", "out_specs"):
+                axes, _ = collect_spec_axes(
+                    module, kwargs.get(key), site["at"]
+                )
+                spec_axes |= axes
+            for axis in sorted(spec_axes - set(mesh_axes)):
+                out.append(
+                    self.finding(
+                        module,
+                        site["node"],
+                        f"{site['desc']} spec names axis {axis!r} but the "
+                        f"mesh at this call site has axes {mesh_axes}; a "
+                        "misspelled axis silently means 'replicated' "
+                        "under check_vma=False",
+                    )
+                )
+        # NamedSharding(mesh, P(...)) sites get the same treatment.
+        for call in module.nodes(ast.Call):
+            dotted = module.dotted_name(call.func)
+            if dotted is None or (
+                dotted.rsplit(".", 1)[-1] != "NamedSharding"
+            ):
+                continue
+            if not call.args:
+                continue
+            mesh_axes = resolve_mesh_axes(module, call.args[0], call)
+            if mesh_axes is None:
+                continue
+            spec_expr = call.args[1] if len(call.args) > 1 else None
+            axes, _ = collect_spec_axes(module, spec_expr, call)
+            for axis in sorted(axes - set(mesh_axes)):
+                out.append(
+                    self.finding(
+                        module,
+                        call,
+                        f"NamedSharding spec names axis {axis!r} but its "
+                        f"mesh has axes {mesh_axes}",
+                    )
+                )
+        return out
+
+
+class CollectiveAxisUnboundRule(Rule):
+    id = "RTL602"
+    name = "collective-axis-unbound"
+    family = "sharding"
+    description = (
+        "collective inside a shard_map/pmap body names an axis the "
+        "enclosing context does not bind"
+    )
+    rationale = (
+        "lax.psum('x') inside a shard_map whose mesh binds only ('dp', "
+        "'tp') is a NameError at trace time — or, when an outer map "
+        "happens to bind 'x' on SOME call paths, a collective over the "
+        "wrong device group: gradients averaged across the wrong "
+        "replicas. shard_map binds ALL mesh axes (the specs are only a "
+        "subset), so the rule fires only when the mesh's axis set is "
+        "statically resolvable and stays silent otherwise."
+    )
+    bad_example = """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def grad_sync(x):
+            return jax.lax.pmean(x, "dp")
+
+        def run(x, devs):
+            mesh = Mesh(devs, ("data", "tp"))
+            f = shard_map(grad_sync, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data"))
+            return f(x)
+    """
+    good_example = """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def grad_sync(x):
+            return jax.lax.pmean(x, "data")
+
+        def run(x, devs):
+            mesh = Mesh(devs, ("data", "tp"))
+            f = shard_map(grad_sync, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data"))
+            return f(x)
+    """
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        sites = shard_sites(module)
+        # A nested shard_map body checks against ITS axes, not the outer
+        # site's — skip resolved inner bodies while walking an outer one.
+        resolved: List[Tuple[dict, ModuleInfo, ast.AST]] = []
+        for site in sites:
+            if site["fn"] is not None:
+                resolved.append((site, module, site["fn"]))
+                continue
+            r = (
+                resolve_function_ex(module, site["fn_expr"], site["at"])
+                if site["fn_expr"] is not None
+                else None
+            )
+            if r is not None:
+                resolved.append((site, r[0], r[1]))
+        inner_fns = {id(fn) for _, _, fn in resolved}
+        for site, def_module, fn in resolved:
+            bound = self._bound_axes(module, site)
+            if bound is None:
+                continue
+            for node in self._body_nodes(fn, inner_fns):
+                hit = self._unbound_collective(def_module, node, bound)
+                if hit is not None:
+                    name, axis = hit
+                    out.append(
+                        self.finding(
+                            def_module,
+                            node,
+                            f"{name} names axis {axis!r} but the "
+                            f"enclosing {site['desc']} binds "
+                            f"{tuple(sorted(bound))}; the collective "
+                            "would trace-fail or reduce over the wrong "
+                            "group",
+                        )
+                    )
+        return out
+
+    def _bound_axes(
+        self, module: ModuleInfo, site: dict
+    ) -> Optional[Set[str]]:
+        """shard_map binds ALL of its mesh's axes in the body — the
+        call's PartitionSpecs are only a SUBSET, so an unresolvable mesh
+        means the bound set is unknowable and the rule must stay silent
+        (a psum over a mesh axis the specs never name is legal and
+        common: replicated input, collective over the idle axis)."""
+        kwargs = site["kwargs"]
+        mesh_axes = resolve_mesh_axes(
+            module, kwargs.get("mesh"), site["at"]
+        )
+        if mesh_axes is not None:
+            return set(mesh_axes)
+        if site["desc"].rsplit(".", 1)[-1] == "pmap":
+            axis_kw = kwargs.get("axis_name")
+            if isinstance(axis_kw, ast.Constant) and isinstance(
+                axis_kw.value, str
+            ):
+                return {axis_kw.value}
+        return None
+
+    @staticmethod
+    def _body_nodes(fn: ast.AST, inner_fns: Set[int]):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if id(node) in inner_fns and node is not fn:
+                continue  # another shard site's body: its own axes apply
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _unbound_collective(
+        self, module: ModuleInfo, node: ast.AST, bound: Set[str]
+    ) -> Optional[Tuple[str, str]]:
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = module.dotted_name(node.func)
+        if dotted is None:
+            return None
+        last = dotted.rsplit(".", 1)[-1]
+        if last not in COLLECTIVE_AXIS_ARG:
+            return None
+        if "lax" not in dotted and "jax_compat" not in dotted:
+            return None
+        axis_expr = None
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_expr = kw.value
+        if axis_expr is None:
+            idx = COLLECTIVE_AXIS_ARG[last]
+            if idx < len(node.args):
+                axis_expr = node.args[idx]
+        if axis_expr is None:
+            return None
+        value = None
+        if isinstance(axis_expr, ast.Constant):
+            value = axis_expr.value
+        elif module.project is not None:
+            value = module.project.resolve_constant(
+                module, axis_expr, node
+            )
+        if value is None:
+            return None
+        axes = value if isinstance(value, tuple) else (value,)
+        for axis in axes:
+            if isinstance(axis, str) and axis not in bound:
+                return (f"{dotted}()", axis)
+        return None
+
+
+RULES = [SpecAxisNotInMeshRule, CollectiveAxisUnboundRule]

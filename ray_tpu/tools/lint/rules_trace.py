@@ -29,7 +29,16 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from ray_tpu.tools.lint.core import Finding, ModuleInfo, Rule
+from ray_tpu.tools.lint.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    _param_names,
+    _resolve_function,
+    _scope_level_nodes,
+    _target_binds,
+    resolve_function_ex,
+)
 
 JIT_WRAPPER_SUFFIXES = ("jit", "pjit", "pmap", "shard_map", "pallas_call")
 
@@ -76,194 +85,45 @@ def _jitted_function_args(module: ModuleInfo, call: ast.Call):
     return out
 
 
-def _target_binds(target: ast.AST, name: str) -> bool:
-    """Does an assignment-like target bind `name`? Sees through tuple /
-    list unpacking and starred elements."""
-    if isinstance(target, ast.Name):
-        return target.id == name
-    if isinstance(target, (ast.Tuple, ast.List)):
-        return any(_target_binds(el, name) for el in target.elts)
-    if isinstance(target, ast.Starred):
-        return _target_binds(target.value, name)
-    return False
-
-
-def _param_names(fn: ast.AST) -> Set[str]:
-    a = fn.args
-    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
-    if a.vararg is not None:
-        names.add(a.vararg.arg)
-    if a.kwarg is not None:
-        names.add(a.kwarg.arg)
-    return names
-
-
-def _scope_level_nodes(scope: ast.AST):
-    """Nodes lexically inside `scope` without descending into nested
-    scopes — a function/class body introduces its own namespace, so its
-    bindings are not visible where `scope`'s are."""
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        node = stack.pop()
-        yield node
-        if not isinstance(
-            node,
-            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
-        ):
-            stack.extend(ast.iter_child_nodes(node))
-
-
-def _resolve_function(
-    module: ModuleInfo, expr: ast.AST, at: ast.AST, _depth: int = 0
-):
-    """Map a function expression to a FunctionDef/Lambda defined in this
-    module: a bare name (module function or sibling nested def), a
-    `self._method`, or an inline lambda. Sees through
-    `functools.partial(fn, ...)` — inline, or bound to a local name first
-    (`kernel = functools.partial(fn, ...)`), the two ways Pallas kernels
-    are handed to pallas_call. None when not resolvable."""
-    if _depth > 8:  # self-referential bindings (f = partial(f, ...))
-        return None
-    if isinstance(expr, ast.Lambda):
-        return expr
-    if isinstance(expr, ast.Call):
-        dotted = module.dotted_name(expr.func)
-        if (
-            dotted is not None
-            and dotted.rsplit(".", 1)[-1] == "partial"
-            and expr.args
-        ):
-            return _resolve_function(module, expr.args[0], at, _depth + 1)
-        return None
-    if isinstance(expr, ast.Name):
-        # Nearest binding in the lexical scope chain of `at`: innermost
-        # scope first, and within a scope the LATEST binding (def or
-        # assignment) wins — a local `kernel = functools.partial(...)`
-        # rebinding shadows an earlier def, exactly as at runtime. Up to
-        # the enclosing function boundary statements execute in lineno
-        # order, so bindings AFTER the use site are not yet live and are
-        # ignored; past that boundary (outer scopes run before the inner
-        # function is called) any binding counts. A local binding we
-        # can't resolve stops the walk: outer scopes are shadowed, so
-        # analyzing them would blame the wrong function.
-        scope = module.parent(at)
-        chain = []
-        while scope is not None:
-            chain.append(scope)
-            scope = module.parent(scope)
-        if not chain or chain[-1] is not module.tree:
-            chain.append(module.tree)
-        sequential = True  # still inside the function body holding `at`
-        crossed_function = False
-        for scope in chain:
-            if isinstance(scope, ast.ClassDef) and crossed_function:
-                # Python name resolution skips class scope from inside
-                # methods: a sibling method or class attr named like the
-                # target is NOT what the bare name resolves to there.
-                continue
-            best = None  # latest live binding of the name in this scope
-            for node in _scope_level_nodes(scope):
-                bind = None
-                if isinstance(
-                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ) and node.name == expr.id:
-                    bind = node
-                elif isinstance(node, ast.Assign) and any(
-                    _target_binds(t, expr.id) for t in node.targets
-                ):
-                    bind = node
-                elif isinstance(
-                    node, (ast.AnnAssign, ast.NamedExpr)
-                ) and _target_binds(node.target, expr.id):
-                    bind = node
-                elif isinstance(
-                    node, (ast.For, ast.AsyncFor)
-                ) and _target_binds(node.target, expr.id):
-                    bind = node
-                elif isinstance(node, (ast.With, ast.AsyncWith)) and any(
-                    item.optional_vars is not None
-                    and _target_binds(item.optional_vars, expr.id)
-                    for item in node.items
-                ):
-                    bind = node
-                if bind is not None and sequential and (
-                    bind.lineno > getattr(at, "lineno", bind.lineno)
-                ):
-                    bind = None  # not yet executed where the call runs
-                if bind is not None and (
-                    best is None or bind.lineno > best.lineno
-                ):
-                    best = bind
-            if isinstance(
-                scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-            ):
-                sequential = False
-                crossed_function = True
-                if best is None and expr.id in _param_names(scope):
-                    # Bound by a parameter: the traced function is
-                    # whatever the caller passes — opaque, and it shadows
-                    # any same-named outer def. Stop, don't misattribute.
-                    return None
-            if best is None:
-                continue
-            if isinstance(best, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return best
-            # Some assignment-like form binds the name in this scope:
-            # resolve its value where one maps to the name directly, else
-            # give up — walking outward would analyze a shadowed,
-            # never-traced binding (tuple unpacking, for/with targets, a
-            # bare `kernel: Callable` annotation are all opaque).
-            if isinstance(best, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == expr.id
-                for t in best.targets
-            ):
-                return _resolve_function(module, best.value, at, _depth + 1)
-            if (
-                isinstance(best, (ast.AnnAssign, ast.NamedExpr))
-                and best.value is not None
-            ):
-                return _resolve_function(module, best.value, at, _depth + 1)
-            return None
-        return None
-    if (
-        isinstance(expr, ast.Attribute)
-        and isinstance(expr.value, ast.Name)
-        and expr.value.id == "self"
-    ):
-        cls = module.parent(at)
-        while cls is not None and not isinstance(cls, ast.ClassDef):
-            cls = module.parent(cls)
-        if cls is not None:
-            for node in cls.body:
-                if isinstance(
-                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ) and node.name == expr.attr:
-                    return node
-    return None
-
-
 def find_jitted_functions(module: ModuleInfo):
-    """(fn_node, wrapper_desc) for every function this module hands to a
-    jit-style wrapper, via call, decorator, or partial-decorator. Memoized
-    per module (two rules consume it)."""
+    """(fn_node, wrapper_desc, defining_module) for every function this
+    module hands to a jit-style wrapper, via call, decorator, or
+    partial-decorator. Resolution crosses module boundaries (an imported
+    step function handed to `jax.jit` is analyzed in ITS file, findings
+    attributed there); a project-level seen-set keeps a function jitted
+    from several modules from being flagged once per importer. Memoized
+    per module (several rules consume it)."""
     cached = module.memo.get("jitted_functions")
     if cached is not None:
         return cached
+    # Project-wide dedup: the defining module may jit the fn itself AND
+    # be referenced by importers — whichever module is checked first owns
+    # the (single) analysis of that function.
+    seen = (
+        module.project.memo.setdefault("jitted_seen_xmodule", set())
+        if module.project is not None
+        else set()
+    )
     out = []
-    seen = set()
     for node in module.nodes(ast.Call):
         if _is_jit_wrapper(module, node.func):
             for arg in _jitted_function_args(module, node):
-                fn = _resolve_function(module, arg, node)
-                if fn is not None and id(fn) not in seen:
-                    seen.add(id(fn))
-                    out.append((fn, module.dotted_name(node.func) or "jit"))
+                resolved = resolve_function_ex(module, arg, node)
+                if resolved is None:
+                    continue
+                def_module, fn = resolved
+                if id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                out.append(
+                    (fn, module.dotted_name(node.func) or "jit", def_module)
+                )
     for node in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
         for dec in node.decorator_list:
             desc = _decorator_jit_desc(module, dec)
             if desc and id(node) not in seen:
                 seen.add(id(node))
-                out.append((node, desc))
+                out.append((node, desc, module))
     module.memo["jitted_functions"] = out
     return out
 
@@ -290,21 +150,48 @@ class JitImpureCallRule(Rule):
         "host side effect inside a jitted function runs once at trace "
         "time and never again"
     )
+    rationale = (
+        "jit traces the Python function ONCE and replays the compiled "
+        "program forever after: time.time(), host random, metric writes "
+        "and print inside it run only at trace time — the value from "
+        "that single run is baked into the executable as a constant, "
+        "silently producing wrong-but-fast programs."
+    )
+    bad_example = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x + t
+    """
+    good_example = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x, t):
+            return x + t
+
+        def run(x):
+            return step(x, time.time())
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
-        for fn, wrapper in find_jitted_functions(module):
+        for fn, wrapper, def_module in find_jitted_functions(module):
             body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
             for stmt in body:
                 for node in ast.walk(stmt):
                     if not isinstance(node, ast.Call):
                         continue
-                    label = self._impure_label(module, node)
+                    label = self._impure_label(def_module, node)
                     if label is None:
                         continue
                     out.append(
                         self.finding(
-                            module,
+                            def_module,
                             node,
                             f"{label} inside a function traced by "
                             f"{wrapper}: it runs once at trace time and "
@@ -343,10 +230,33 @@ class JitClosureMutationRule(Rule):
         "mutating self/global/closed-over state inside a jitted function "
         "happens at trace time only"
     )
+    rationale = (
+        "the same trace-once hazard as RTL301, for state instead of "
+        "values: a self/global/closure write inside a jitted function "
+        "executes during tracing and never again — the counter stays at "
+        "1, the cache holds a tracer. Return the value instead."
+    )
+    bad_example = """
+        import jax
+
+        log = []
+
+        @jax.jit
+        def bad(x):
+            log.append(x)
+            return x
+    """
+    good_example = """
+        import jax
+
+        @jax.jit
+        def good(x):
+            return x, x * 2  # return what the caller should record
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []
-        for fn, wrapper in find_jitted_functions(module):
+        for fn, wrapper, def_module in find_jitted_functions(module):
             if isinstance(fn, ast.Lambda):
                 continue  # lambdas cannot contain statements
             local_names = self._local_bindings(fn)
@@ -354,7 +264,7 @@ class JitClosureMutationRule(Rule):
                 if isinstance(stmt, (ast.Global, ast.Nonlocal)):
                     out.append(
                         self.finding(
-                            module, stmt,
+                            def_module, stmt,
                             f"global/nonlocal write inside a function "
                             f"traced by {wrapper} mutates host state at "
                             "trace time only",
@@ -371,7 +281,7 @@ class JitClosureMutationRule(Rule):
                         if desc is not None:
                             out.append(
                                 self.finding(
-                                    module, t,
+                                    def_module, t,
                                     f"{desc} inside a function traced by "
                                     f"{wrapper} runs at trace time only; "
                                     "return the value instead",
@@ -390,7 +300,7 @@ class JitClosureMutationRule(Rule):
                     ):
                         out.append(
                             self.finding(
-                                module, call,
+                                def_module, call,
                                 f"{func.value.id}.{func.attr}(...) mutates "
                                 f"closed-over state inside a function "
                                 f"traced by {wrapper} (trace-time only)",
@@ -451,6 +361,33 @@ class WallClockDurationRule(Rule):
         "time.monotonic()/perf_counter() unless wall-clock identity is "
         "required"
     )
+    rationale = (
+        "wall clock steps under NTP/suspend: `deadline = time.time() + "
+        "t` can park a poller forever after a backward step, and "
+        "`time.time() - t0` durations jitter. Monotonic clocks exist "
+        "for exactly this; keep time.time() only where wall-clock "
+        "IDENTITY matters (timestamps compared across processes)."
+    )
+    bad_example = """
+        import time
+
+        def wait_for(pred, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return True
+            return False
+    """
+    good_example = """
+        import time
+
+        def wait_for(pred, timeout):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+            return False
+    """
 
     def check(self, module: ModuleInfo) -> List[Finding]:
         out: List[Finding] = []

@@ -16,7 +16,8 @@ from pathlib import Path
 
 import pytest
 
-from ray_tpu.tools.lint import lint_paths, lint_source
+from ray_tpu.tools.lint import all_rules, lint_paths, lint_source
+from ray_tpu.tools.lint.core import lint_sources
 from ray_tpu.tools.lint import baseline as baseline_mod
 from ray_tpu.tools.lint.cli import main as lint_main
 
@@ -31,6 +32,14 @@ def rules_of(findings):
 
 def lint(src, **kwargs):
     return lint_source(textwrap.dedent(src), **kwargs)
+
+
+def lint_files(files, **kwargs):
+    """Multi-module fixture harness: {relpath: source} through one
+    project (symbol table / call graph / actor index span the dict)."""
+    return lint_sources(
+        {p: textwrap.dedent(s) for p, s in files.items()}, **kwargs
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -1120,7 +1129,10 @@ def test_cli_json_shape(tmp_path, capsys, monkeypatch):
     rc = lint_main([str(pkg), "--json"])
     report = json.loads(capsys.readouterr().out)
     assert rc == 1
-    assert report["version"] == 1
+    # Schema version 2: the project-level pass added the schema field so
+    # external consumers can gate on report shape.
+    assert report["version"] == 2
+    assert report["schema"] == "ray-tpu-lint-report/2"
     assert report["files_scanned"] == 1
     assert set(report["counts"]) == {
         "active", "baselined", "suppressed", "parse_errors",
@@ -1343,7 +1355,13 @@ def test_write_baseline_preserves_entries_of_unparseable_file(
 def test_repo_is_lint_clean():
     """`python -m ray_tpu.tools.lint ray_tpu/` must exit 0: every finding
     on the tree is fixed, suppressed with a reason, or baselined with a
-    reason — and the scan fits the CI budget (<10s)."""
+    reason — and the scan, INCLUDING the cross-module project pass the
+    RTL5xx/6xx/7xx families ride on, fits the CI budget (<10s; `make
+    lint` runs the same gate outside pytest)."""
+    # The gate runs the full registry: donation/sharding/actor families
+    # must be in it, or a tree full of use-after-donates reads as clean.
+    families = {r.id[:4] for r in all_rules()}
+    assert {"RTL5", "RTL6", "RTL7"} <= families
     baseline = baseline_mod.load_baseline(
         REPO_ROOT / baseline_mod.BASELINE_FILENAME
     )
@@ -1372,3 +1390,916 @@ def test_every_suppression_in_repo_has_reason():
         root=REPO_ROOT,
     )
     assert result.findings == []
+
+
+# ---------------------------------------------------------------------------
+# Rule examples are executable: every rule's --explain snippets double as
+# fixture tests (one firing + one exempt per rule), so the CLI's examples
+# can never drift from what the rule actually flags.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rule", all_rules(), ids=lambda r: r.id
+)
+def test_rule_example_pair_fires_and_stays_clean(rule):
+    assert rule.rationale, f"{rule.id} has no rationale for --explain"
+    assert rule.bad_example and rule.good_example
+    bad = rules_of(lint(rule.bad_example))
+    good = rules_of(lint(rule.good_example))
+    assert rule.id in bad, f"{rule.id} does not fire on its own bad example"
+    assert rule.id not in good, f"{rule.id} fires on its own good example"
+
+
+# ---------------------------------------------------------------------------
+# Family 5: donation / JAX-perf
+# ---------------------------------------------------------------------------
+
+
+def test_use_after_donate_in_loop_without_rebind():
+    """A donating call inside a loop donates the same name every
+    iteration: with no rebind, the second iteration reads a dead buffer."""
+    findings = lint(
+        """
+        import jax
+
+        def train(step_fn, params, batches):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            losses = []
+            for batch in batches:
+                out = step(params, batch)
+                losses.append(out[1])
+            return losses
+        """
+    )
+    assert "RTL501" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        def train(step_fn, params, batches):
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            losses = []
+            for batch in batches:
+                params, loss = step(params, batch)
+                losses.append(loss)
+            return params
+        """
+    )
+    assert "RTL501" not in rules_of(findings)
+
+
+def test_use_after_donate_self_attr_binding_and_argnames():
+    """Donation through a self-attr binding (`self._fn = jax.jit(...)`),
+    with donate_argnames mapped through the wrapped method's params."""
+    findings = lint(
+        """
+        import jax
+
+        class Runner:
+            def __init__(self):
+                self._fn = jax.jit(self._step, donate_argnames=("cache",))
+
+            def _step(self, cache, x):
+                return cache + x, x
+
+            def run(self, x):
+                new_cache, y = self._fn(self.cache, x)
+                stale = self.cache.sum()  # donated buffer
+                self.cache = new_cache
+                return y, stale
+        """
+    )
+    assert "RTL501" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        class Runner:
+            def __init__(self):
+                self._fn = jax.jit(self._step, donate_argnames=("cache",))
+
+            def _step(self, cache, x):
+                return cache + x, x
+
+            def run(self, x):
+                self.cache, y = self._fn(self.cache, x)
+                total = self.cache.sum()  # the NEW buffer
+                return y, total
+        """
+    )
+    assert "RTL501" not in rules_of(findings)
+
+
+def test_use_after_donate_starred_positions_not_guessed():
+    """Positions at/after a *splat are unknowable — the rule must stay
+    silent rather than blame the wrong argument (model_runner's own
+    `self._decode_fn(self.params, *self._pools, ...)` shape)."""
+    findings = lint(
+        """
+        import jax
+
+        class R:
+            def __init__(self):
+                self._fn = jax.jit(self._step, donate_argnums=(1, 2))
+
+            def _step(self, a, b, c):
+                return a, b, c
+
+            def run(self, x):
+                out = self._fn(self.params, *self.pools, x)
+                return self.pools  # position unknown: no claim
+        """
+    )
+    assert "RTL501" not in rules_of(findings)
+
+
+def test_unstable_static_arg_shapes():
+    """List literal (unhashable) and a non-frozen dataclass resolved
+    ACROSS modules both destroy the jit cache; a frozen dataclass has
+    eq+hash and is exempt."""
+    findings = lint(
+        """
+        import jax
+
+        def run(fn, x):
+            f = jax.jit(fn, static_argnums=(1,))
+            return f(x, [1, 2, 3])
+        """
+    )
+    assert "RTL502" in rules_of(findings)
+
+    cfg = """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class StepConfig:
+            n: int = 1
+
+        @dataclasses.dataclass(frozen=True)
+        class FrozenConfig:
+            n: int = 1
+    """
+    findings = lint_files(
+        {
+            "pkg/cfg.py": cfg,
+            "pkg/run.py": """
+                import jax
+                from pkg.cfg import StepConfig
+
+                def run(fn, x):
+                    f = jax.jit(fn, static_argnums=(1,))
+                    return f(x, StepConfig(n=2))
+            """,
+        }
+    )
+    assert "RTL502" in rules_of(findings)
+
+    findings = lint_files(
+        {
+            "pkg/cfg.py": cfg,
+            "pkg/run.py": """
+                import jax
+                from pkg.cfg import FrozenConfig
+
+                def run(fn, x):
+                    f = jax.jit(fn, static_argnums=(1,))
+                    return f(x, FrozenConfig(n=2))
+            """,
+        }
+    )
+    assert "RTL502" not in rules_of(findings)
+
+
+def test_unbucketed_len_shape_flagged_bucket_helper_exempt():
+    """A len()-derived array shape fed to a jitted program compiles one
+    program per distinct length; routing the size through a bucketing
+    helper (model_runner's `bucket_for`) is the sanctioned form."""
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def prefill(fn, token_ids):
+            step = jax.jit(fn)
+            n = len(token_ids)
+            tokens = np.zeros((1, n), np.int32)
+            return step(tokens)
+        """
+    )
+    assert "RTL502" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def prefill(fn, cfg, token_ids):
+            step = jax.jit(fn)
+            n = len(token_ids)
+            bucket = cfg.bucket_for(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            return step(tokens)
+        """
+    )
+    assert "RTL502" not in rules_of(findings)
+
+
+def test_host_sync_item_in_while_loop_and_post_loop_exempt():
+    findings = lint(
+        """
+        import jax
+
+        def fit(step_fn, params, n):
+            step = jax.jit(step_fn)
+            i = 0
+            while i < n:
+                params, loss = step(params)
+                print_loss = loss.item()
+                i += 1
+            return params
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        def fit(step_fn, params, n):
+            step = jax.jit(step_fn)
+            losses = []
+            for _ in range(n):
+                params, loss = step(params)
+                losses.append(loss)
+            return params, [x.item() for x in losses]
+        """
+    )
+    assert "RTL503" not in rules_of(findings)
+
+
+def test_host_sync_device_get_and_block_until_ready_flagged():
+    findings = lint(
+        """
+        import jax
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            out = []
+            for b in batches:
+                params, m = step(params, b)
+                out.append(jax.device_get(m))
+            return params, out
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            for b in batches:
+                params, m = step(params, b)
+                jax.block_until_ready(m)
+            return params
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Family 6: sharding consistency
+# ---------------------------------------------------------------------------
+
+
+def test_spec_axis_resolved_through_cross_module_constant():
+    """The mesh's axis tuple lives in another module (the
+    parallel/mesh.py AXIS_ORDER shape): a spec axis missing from it is a
+    proven mismatch; a spec using those axes is clean."""
+    mesh_mod = """
+        AXIS_ORDER = ("dp", "tp")
+
+        def build_mesh(devs):
+            from jax.sharding import Mesh
+            return Mesh(devs, AXIS_ORDER)
+    """
+    findings = lint_files(
+        {
+            "pkg/mesh.py": mesh_mod,
+            "pkg/run.py": """
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu._private.jax_compat import shard_map
+                from pkg.mesh import build_mesh
+
+                def run(fn, x, devs):
+                    mesh = build_mesh(devs)
+                    f = shard_map(fn, mesh=mesh, in_specs=(P("model"),),
+                                  out_specs=P("dp"))
+                    return f(x)
+            """,
+        }
+    )
+    assert "RTL601" in rules_of(findings)
+
+    findings = lint_files(
+        {
+            "pkg/mesh.py": mesh_mod,
+            "pkg/run.py": """
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu._private.jax_compat import shard_map
+                from pkg.mesh import build_mesh
+
+                def run(fn, x, devs):
+                    mesh = build_mesh(devs)
+                    f = shard_map(fn, mesh=mesh, in_specs=(P("tp"),),
+                                  out_specs=P("dp"))
+                    return f(x)
+            """,
+        }
+    )
+    assert "RTL601" not in rules_of(findings)
+
+
+def test_spec_axis_through_specbuild_method():
+    """`Spec(...).build()` resolves through the class's build() returns
+    (the MeshSpec.build shape)."""
+    findings = lint(
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        AXES = ("pp", "dp")
+
+        class Spec:
+            def build(self, devs):
+                return Mesh(devs, AXES)
+
+        def run(fn, x, devs):
+            mesh = Spec().build(devs)
+            f = shard_map(fn, mesh=mesh, in_specs=(P("sp"),),
+                          out_specs=P("dp"))
+            return f(x)
+        """
+    )
+    assert "RTL601" in rules_of(findings)
+
+
+def test_unknown_mesh_stays_silent():
+    """A mesh that is a bare parameter is not statically known — the
+    rule must not guess."""
+    findings = lint(
+        """
+        from jax.sharding import PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def run(fn, x, mesh):
+            f = shard_map(fn, mesh=mesh, in_specs=(P("anything"),),
+                          out_specs=P("whatever"))
+            return f(x)
+        """
+    )
+    assert "RTL601" not in rules_of(findings)
+
+
+def test_collective_axis_partial_decorator_and_unknown_mesh_silent():
+    """The partial-decorator shard_map form (pipeline.py's shape) with a
+    resolvable mesh: a collective over an axis outside the mesh fires.
+    With the mesh a bare parameter, shard_map binds ALL of its (unknown)
+    axes — the specs are only a subset — so the rule must stay silent
+    even for axes the specs never name (psum over an idle mesh axis with
+    replicated input is legal and common)."""
+    findings = lint(
+        """
+        import jax
+        from functools import partial
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def build(devs):
+            mesh = Mesh(devs, ("pp", "dp"))
+
+            @partial(shard_map, mesh=mesh, in_specs=(P("pp"),),
+                     out_specs=P("pp"))
+            def run(x):
+                stage = jax.lax.axis_index("pp")
+                return jax.lax.psum(x, "sp") + stage
+            return run
+        """
+    )
+    # "pp"/"dp" are mesh axes; "sp" is not.
+    rtl602 = [f for f in findings if f.rule == "RTL602"]
+    assert len(rtl602) == 1
+    assert "'sp'" in rtl602[0].message
+
+    findings = lint(
+        """
+        import jax
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def build(mesh):
+            @partial(shard_map, mesh=mesh, in_specs=(P("pp"),),
+                     out_specs=P("pp"))
+            def run(x):
+                return jax.lax.psum(x, "dp")  # may be a real mesh axis
+            return run
+        """
+    )
+    assert "RTL602" not in rules_of(findings)
+
+
+def test_collective_axis_in_pmap_body():
+    findings = lint(
+        """
+        import jax
+
+        def grad_sync(x):
+            return jax.lax.pmean(x, "devices")
+
+        def run(x):
+            return jax.pmap(grad_sync, axis_name="batch")(x)
+        """
+    )
+    assert "RTL602" in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        def grad_sync(x):
+            return jax.lax.pmean(x, "batch")
+
+        def run(x):
+            return jax.pmap(grad_sync, axis_name="batch")(x)
+        """
+    )
+    assert "RTL602" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Family 7: actor call-graph deadlocks
+# ---------------------------------------------------------------------------
+
+
+def test_same_actor_blocking_get_via_partial_bound_remote():
+    """functools.partial-bound remote methods resolve to the underlying
+    handle (the satellite cross-module shape)."""
+    findings = lint(
+        """
+        import functools
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Coord:
+            def __init__(self):
+                self._peer = Coord.remote()
+
+            def helper(self, x):
+                return x
+
+            def run(self, x):
+                fire = functools.partial(self._peer.helper.remote, x)
+                ref = fire()
+                return ray_tpu.get(ref)
+        """
+    )
+    assert "RTL701" in rules_of(findings)
+
+
+def test_cross_actor_cycle_with_aliased_import():
+    """A -> B -> A across modules, with B's class imported under another
+    name (actor-class-aliased-at-import satellite)."""
+    findings = lint_files(
+        {
+            "pkg/beta.py": """
+                import ray_tpu
+                from pkg import alpha
+
+                @ray_tpu.remote
+                class Beta:
+                    def __init__(self):
+                        self._a = alpha.Alpha.remote()
+
+                    def pong(self, x):
+                        return ray_tpu.get(self._a.poke.remote(x))
+            """,
+            "pkg/alpha.py": """
+                import ray_tpu
+
+                @ray_tpu.remote
+                class Alpha:
+                    def __init__(self):
+                        from pkg.beta import Beta as Remote_B
+                        self._b = Remote_B.remote()
+
+                    def ping(self, x):
+                        return ray_tpu.get(self._b.pong.remote(x))
+
+                    def poke(self, x):
+                        return x
+            """,
+        }
+    )
+    assert rules_of(findings).count("RTL702") == 2
+
+    # One-way dependency: no cycle, no finding.
+    findings = lint_files(
+        {
+            "pkg/beta.py": """
+                import ray_tpu
+
+                @ray_tpu.remote
+                class Beta:
+                    def pong(self, x):
+                        return x + 1
+            """,
+            "pkg/alpha.py": """
+                import ray_tpu
+                from pkg.beta import Beta
+
+                @ray_tpu.remote
+                class Alpha:
+                    def __init__(self):
+                        self._b = Beta.remote()
+
+                    def ping(self, x):
+                        return ray_tpu.get(self._b.pong.remote(x))
+            """,
+        }
+    )
+    assert "RTL702" not in rules_of(findings)
+
+
+def test_registered_handle_name_resolves_cross_module():
+    """`RemoteX = ray_tpu.remote(X)` registrations resolve from another
+    module (the rllib RemoteEnvRunner shape)."""
+    findings = lint_files(
+        {
+            "pkg/worker.py": """
+                import ray_tpu
+
+                class Worker:
+                    def work(self, x):
+                        return x
+
+                RemoteWorker = ray_tpu.remote(Worker)
+            """,
+            "pkg/driver.py": """
+                import ray_tpu
+                from pkg.worker import RemoteWorker
+
+                @ray_tpu.remote
+                class Driver:
+                    def __init__(self):
+                        self._w = RemoteWorker.options(num_cpus=0).remote()
+
+                    def run(self, x):
+                        return ray_tpu.get(self._w.work.remote(x))
+            """,
+        }
+    )
+    # One-way blocking call: NOT a deadlock — no findings, but the edge
+    # resolving at all is what this test pins (a cycle through the same
+    # registration shape must then be detectable).
+    assert "RTL702" not in rules_of(findings)
+    assert "RTL701" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Cross-module resolution edge cases (tentpole satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_jit_of_imported_function_attributed_to_defining_module():
+    """`jax.jit(imported_fn)` analyzes the function in ITS module and
+    attributes the finding there."""
+    findings = lint_files(
+        {
+            "pkg/steps.py": """
+                import time
+
+                def step(x):
+                    return x * time.time()
+            """,
+            "pkg/run.py": """
+                import jax
+                from pkg.steps import step
+
+                def run(x):
+                    return jax.jit(step)(x)
+            """,
+        }
+    )
+    rtl301 = [f for f in findings if f.rule == "RTL301"]
+    assert len(rtl301) == 1
+    assert rtl301[0].path == "pkg/steps.py"
+
+
+def test_import_alias_chain_resolves():
+    """`from x import y as z` chains terminate at the real definition."""
+    findings = lint_files(
+        {
+            "pkg/a.py": """
+                import time
+
+                def impure_step(x):
+                    return x * time.time()
+            """,
+            "pkg/b.py": """
+                from pkg.a import impure_step as hop1
+            """,
+            "pkg/c.py": """
+                import jax
+                from pkg.b import hop1 as hop2
+
+                def run(x):
+                    return jax.jit(hop2)(x)
+            """,
+        }
+    )
+    rtl301 = [f for f in findings if f.rule == "RTL301"]
+    assert len(rtl301) == 1
+    assert rtl301[0].path == "pkg/a.py"
+
+
+def test_reexport_through_package_init_resolves():
+    """Re-exports through __init__.py resolve like the real module path."""
+    findings = lint_files(
+        {
+            "pkg/__init__.py": """
+                from pkg.inner import step
+            """,
+            "pkg/inner.py": """
+                import time
+
+                def step(x):
+                    return x + time.time()
+            """,
+            "app.py": """
+                import jax
+                import pkg
+
+                def run(x):
+                    return jax.jit(pkg.step)(x)
+            """,
+        }
+    )
+    rtl301 = [f for f in findings if f.rule == "RTL301"]
+    assert len(rtl301) == 1
+    assert rtl301[0].path == "pkg/inner.py"
+
+
+def test_cross_module_finding_suppressable_in_defining_module():
+    """The inline ignore lives where the finding lands: the DEFINING
+    module, even when the jit call is elsewhere."""
+    findings = lint_files(
+        {
+            "pkg/steps.py": """
+                import time
+
+                def step(x):
+                    # ray-tpu: lint-ignore[RTL301] trace-time stamp is the
+                    # documented behavior of this fixture
+                    return x * time.time()
+            """,
+            "pkg/run.py": """
+                import jax
+                from pkg.steps import step
+
+                def run(x):
+                    return jax.jit(step)(x)
+            """,
+        }
+    )
+    assert "RTL301" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --sarif, --explain
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_shape(tmp_path, capsys, monkeypatch):
+    pkg = _write_pkg(tmp_path)  # mod.py: RTL302 + RTL401
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(pkg), "--sarif"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["version"] == "2.1.0"
+    assert report["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = report["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "ray-tpu-lint"
+    ids = {r["id"] for r in driver["rules"]}
+    assert {"RTL501", "RTL601", "RTL701"} <= ids
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"RTL302", "RTL401"}
+    for r in results:
+        assert r["level"] == "warning"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("mod.py")
+        assert loc["region"]["startLine"] >= 1
+        assert r["partialFingerprints"]["rayTpuLint/v1"]
+    # Clean tree -> empty results, exit 0.
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "ok.py").write_text("x = 1\n")
+    capsys.readouterr()
+    assert lint_main([str(clean), "--sarif"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["runs"][0]["results"] == []
+
+
+def test_cli_explain_prints_rationale_and_examples(capsys):
+    assert lint_main(["--explain", "RTL501"]) == 0
+    out = capsys.readouterr().out
+    assert "use-after-donate" in out
+    assert "Why:" in out
+    assert "Fires on:" in out and "Clean form:" in out
+    assert "donate_argnums" in out
+    # By name works too; unknown rule is a usage error.
+    assert lint_main(["--explain", "cross-actor-call-cycle"]) == 0
+    capsys.readouterr()
+    assert lint_main(["--explain", "RTL999"]) == 2
+
+
+def test_actor_cycle_through_reachable_helper():
+    """The actor-method reachability index: a blocking get inside a
+    plain helper function REACHED from an actor method (through the
+    project call graph, across modules) contributes that actor's edge —
+    here closing an A→B→A cycle whose first leg lives in a helper."""
+    findings = lint_files(
+        {
+            "pkg/helpers.py": """
+                import ray_tpu
+                from pkg.beta import Beta
+
+                def fetch_pong(x):
+                    h = Beta.remote()
+                    return ray_tpu.get(h.pong.remote(x))
+            """,
+            "pkg/alpha.py": """
+                import ray_tpu
+                from pkg.helpers import fetch_pong
+
+                @ray_tpu.remote
+                class Alpha:
+                    def ping(self, x):
+                        return fetch_pong(x)
+
+                    def poke(self, x):
+                        return x
+            """,
+            "pkg/beta.py": """
+                import ray_tpu
+
+                @ray_tpu.remote
+                class Beta:
+                    def __init__(self):
+                        from pkg.alpha import Alpha
+                        self._a = Alpha.remote()
+
+                    def pong(self, x):
+                        return ray_tpu.get(self._a.poke.remote(x))
+            """,
+        }
+    )
+    rtl702 = [f for f in findings if f.rule == "RTL702"]
+    assert len(rtl702) == 2
+    assert {f.path for f in rtl702} == {"pkg/helpers.py", "pkg/beta.py"}
+    # The helper-side finding names the reaching method.
+    helper_f = [f for f in rtl702 if f.path == "pkg/helpers.py"][0]
+    assert "via fetch_pong" in helper_f.message
+
+
+def test_decorated_method_donate_argnums_rebased_on_call_args():
+    """A decorated METHOD's donate_argnums count `self`; call sites pass
+    args without it. Position 1 of `def step(self, params, batch)` is
+    `params` — the rule must flag a later read of params, not batch."""
+    findings = lint(
+        """
+        import functools
+        import jax
+
+        class Trainer:
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def step(self, params, batch):
+                return params, batch
+
+            def fit(self, params, batch):
+                new_params, out = self.step(params, batch)
+                stale = params.sum()   # donated (argnum 1 == params)
+                tail = batch.sum()     # NOT donated
+                return new_params, stale, tail
+        """
+    )
+    rtl501 = [f for f in findings if f.rule == "RTL501"]
+    assert len(rtl501) == 1
+    assert "`params`" in rtl501[0].message
+
+
+def test_attr_jit_bindings_keyed_per_class_with_inheritance():
+    """Review regression: `self._fn` in one class must not resolve to
+    another class's jit binding of the same attribute name — but a
+    SUBCLASS method must still see a binding its parent's __init__ set
+    up (the PerPolicyMultiAgentRunner shape)."""
+    findings = lint(
+        """
+        import jax
+
+        class Donating:
+            def __init__(self, f):
+                self._fn = jax.jit(f, donate_argnums=(0,))
+
+        class Plain:
+            def __init__(self, fn):
+                self._fn = fn
+
+            def run(self, params, x):
+                y = self._fn(params, x)
+                return params.sum(), y  # _fn here never donates
+        """
+    )
+    assert "RTL501" not in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+
+        class Base:
+            def __init__(self, f):
+                self._fn = jax.jit(f, donate_argnums=(0,))
+
+        class Sub(Base):
+            def run(self, params, x):
+                y = self._fn(params, x)
+                return params.sum(), y  # inherited donating binding
+        """
+    )
+    assert "RTL501" in rules_of(findings)
+
+
+def test_jnp_asarray_is_a_device_op_not_a_sync():
+    """Review regression: jnp.asarray of a device array stays on device;
+    only a NUMPY-rooted asarray/array forces the host transfer."""
+    findings = lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            out = []
+            for b in batches:
+                params, m = step(params, b)
+                out.append(jnp.asarray(m))  # device op, no host read
+            return params, out
+        """
+    )
+    assert "RTL503" not in rules_of(findings)
+
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def fit(step_fn, params, batches):
+            step = jax.jit(step_fn)
+            out = []
+            for b in batches:
+                params, m = step(params, b)
+                out.append(np.asarray(m))  # host transfer every step
+            return params, out
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+
+def test_function_local_registration_does_not_leak():
+    """Review regression: a method-local `h = ray_tpu.remote(Cls)` must
+    not register module-wide, and an OPAQUE local binding of the same
+    name elsewhere must not fall back to any registration."""
+    findings = lint(
+        """
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Driver:
+            def spawn(self):
+                h = ray_tpu.remote(Driver)
+                return h
+
+            def poll(self):
+                h = make_handle()  # opaque: class unknown
+                return ray_tpu.get(h.work.remote(1))
+
+            def work(self, x):
+                return x
+        """
+    )
+    assert "RTL701" not in rules_of(findings)

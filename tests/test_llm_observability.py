@@ -347,8 +347,19 @@ def test_streamed_request_yields_one_connected_trace(serve_ray):
     # Cache pressure: three background generations keep the 11-block pool
     # oversubscribed, so the traced stream (youngest arrival) gets
     # preempted and resumed at least once.
+    #
+    # The background streams must OUTLIVE the traced stream, not just
+    # overlap its start: this test used to be the rotating tier-1 flake —
+    # at 12 background tokens the bg requests could drain in the window
+    # between the pressure check below and the traced stream's admission
+    # (a gc pause or a loaded box stretches that window), leaving a full
+    # pool and no preemption to trace. 24 tokens makes the pressure
+    # deterministic by construction: each bg sequence grows to 8 blocks
+    # (its max_blocks_per_seq cap), 3 x 8 = 24 blocks against an 11-block
+    # pool, and ~24 interleaved decode steps comfortably cover the traced
+    # stream's 12 tokens + mid-stream failover + resume.
     bg_prompts = random_prompts((6, 6, 5), seed=8)
-    bg = [engine.generate.remote(p, 12) for p in bg_prompts]
+    bg = [engine.generate.remote(p, 24) for p in bg_prompts]
     # The traced stream must be the YOUNGEST arrival (the scheduler preempts
     # youngest-first), so wait until the background load is in the engine.
     deadline = time.monotonic() + 30
