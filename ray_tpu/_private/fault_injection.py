@@ -1,7 +1,8 @@
 """Deterministic fault injection for chaos testing.
 
 Hot paths call ``maybe_fail(site, detail)`` at named injection points — the
-engine step (`llm.step`, `llm.prefill`, `llm.decode.seq`), the Serve replica
+engine step (`llm.step`, `llm.prefill`, `llm.decode.seq`, `engine.verify`
+for the speculative-decoding commit section), the Serve replica
 (`replica.handle_request`, `replica.handle_request_streaming`,
 `replica.stream_item`), actor-task submission (`actor.submit`), and replica
 startup (`controller.start_replica`). With no faults configured the call is

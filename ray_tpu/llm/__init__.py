@@ -10,6 +10,8 @@ Pure-Python library on the actor/object core (the Ray layering principle):
   * engine.py — LLMEngine core + LLMServer engine actor
   * observability.py — per-request lifecycle spans, latency-histogram
     boundaries, and the engine flight recorder
+  * spec/ — speculative decoding proposers (n-gram prompt lookup, draft
+    model) feeding the engine's k-token verify-with-rollback phase
   * serve.py — ingress deployment behind the existing HTTP proxy/replicas
 """
 
@@ -34,6 +36,7 @@ from ray_tpu.llm.scheduler import (
     Scheduler,
     Sequence,
 )
+from ray_tpu.llm.spec import NgramProposer, Proposer, build_proposer
 
 __all__ = [
     "BlockAllocator",
@@ -48,10 +51,13 @@ __all__ = [
     "LLMEngine",
     "LLMServer",
     "NULL_BLOCK",
+    "NgramProposer",
+    "Proposer",
     "Request",
     "Scheduler",
     "Sequence",
     "blocks_for_tokens",
+    "build_proposer",
     "hash_block_tokens",
     "prefix_block_hashes",
 ]

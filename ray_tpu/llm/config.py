@@ -11,7 +11,7 @@ XLA's static-shape constraint.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +72,37 @@ class EngineConfig:
     # within quantization tolerance of bf16; greedy argmax is expected to
     # match on typical prompts but is not bit-guaranteed.
     kv_cache_dtype: str = "auto"
+    # Decode-time sampling policy. Only "greedy" (argmax) is implemented;
+    # the knob exists so speculative decoding can reject non-greedy
+    # configurations explicitly until rejection sampling lands.
+    sampling: str = "greedy"
+    # Speculative decoding (ray_tpu.llm.spec): "off" decodes one token per
+    # sequence per step; "ngram" proposes continuations by matching the
+    # sequence's own token history against its tail (prompt lookup — no
+    # draft model, pure host-side matching); "draft" runs a second,
+    # smaller GPT (draft_model_config) through the same runner harness.
+    # Either way the target model scores all k proposed tokens in ONE
+    # verify step against the paged KV cache, accepts the longest agreeing
+    # prefix plus the correction/bonus token, and rolls back rejected
+    # tokens (block-table trim + context-length rewind) — so greedy
+    # outputs are token-identical with speculation on or off, and each
+    # verify step emits between 1 and k+1 tokens. (Under
+    # kv_cache_dtype="int8" the identity inherits int8's own
+    # within-quantization-tolerance contract — the caveat partial
+    # prefill already carries.)
+    speculation: str = "off"
+    # How many tokens a proposer may run ahead per verify step (k). The
+    # verify program is compiled per fed-width bucket (1 + proposed,
+    # powers of two up to k); each sequence speculates at most
+    # min(k, its remaining budget - 1, cache capacity).
+    num_speculative_tokens: int = 4
+    # n-gram proposer: longest/shortest history suffix to match. Longer
+    # matches are tried first (higher precision), falling back to shorter.
+    ngram_max: int = 3
+    ngram_min: int = 1
+    # GPTConfig of the draft model (required iff speculation="draft").
+    # It must satisfy max_seq_len >= max_model_len, like the target.
+    draft_model_config: Optional[Any] = None
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-
@@ -122,6 +153,58 @@ class EngineConfig:
                 "kv_cache_dtype must be one of ('auto', 'bf16', 'int8'), "
                 f"got {self.kv_cache_dtype!r}"
             )
+        if self.speculation not in ("off", "ngram", "draft"):
+            raise ValueError(
+                "speculation must be one of ('off', 'ngram', 'draft'), "
+                f"got {self.speculation!r}"
+            )
+        if self.sampling != "greedy":
+            if self.speculation != "off":
+                # Rejection sampling for stochastic decoding is not
+                # implemented: verification compares proposals against the
+                # target's argmax, which is only correct for greedy.
+                raise ValueError(
+                    "speculative decoding requires greedy sampling until "
+                    "rejection sampling is supported; got "
+                    f"sampling={self.sampling!r} with "
+                    f"speculation={self.speculation!r}"
+                )
+            raise ValueError(
+                "sampling must be 'greedy' (the only implemented policy), "
+                f"got {self.sampling!r}"
+            )
+        if self.num_speculative_tokens < 1:
+            raise ValueError(
+                "num_speculative_tokens must be >= 1, got "
+                f"{self.num_speculative_tokens}"
+            )
+        if (
+            self.speculation != "off"
+            and self.num_speculative_tokens >= self.max_model_len
+        ):
+            raise ValueError(
+                f"num_speculative_tokens {self.num_speculative_tokens} "
+                f"must be < max_model_len {self.max_model_len} (a sequence "
+                "can never verify more tokens than the cache can hold)"
+            )
+        if self.ngram_min < 1:
+            raise ValueError("ngram_min must be >= 1")
+        if self.ngram_max < self.ngram_min:
+            raise ValueError(
+                f"ngram_max ({self.ngram_max}) must be >= ngram_min "
+                f"({self.ngram_min})"
+            )
+        if self.speculation == "draft" and self.draft_model_config is None:
+            raise ValueError(
+                'speculation="draft" requires draft_model_config (the '
+                "draft GPTConfig)"
+            )
+        if self.speculation != "draft" and self.draft_model_config is not None:
+            raise ValueError(
+                "draft_model_config is only meaningful with "
+                f'speculation="draft" (got speculation={self.speculation!r});'
+                " a silently-ignored draft model is a misconfiguration"
+            )
         from ray_tpu.llm.cache import EVICTION_POLICIES
 
         if self.prefix_eviction_policy not in EVICTION_POLICIES:
@@ -147,4 +230,27 @@ class EngineConfig:
                 return b
         raise ValueError(
             f"prompt of {n} tokens exceeds max_model_len {self.max_model_len}"
+        )
+
+    def verify_buckets(self) -> Tuple[int, ...]:
+        """Fed-token widths (1 + proposed tokens, proposal counts bucketed
+        to powers of two up to num_speculative_tokens) the k-token verify
+        program compiles — O(log k) programs, warmed at init like the
+        prefill buckets. Empty when speculation is off."""
+        if self.speculation == "off":
+            return ()
+        out, b = [], 1
+        while b < self.num_speculative_tokens:
+            out.append(1 + b)
+            b *= 2
+        out.append(1 + self.num_speculative_tokens)
+        return tuple(out)
+
+    def verify_bucket_for(self, n_fed: int) -> int:
+        for b in self.verify_buckets():
+            if b >= n_fed:
+                return b
+        raise ValueError(
+            f"verify step of {n_fed} fed tokens exceeds the largest verify "
+            f"bucket (num_speculative_tokens={self.num_speculative_tokens})"
         )

@@ -47,6 +47,7 @@ from ray_tpu.llm.scheduler import (
     Scheduler,
     Sequence,
 )
+from ray_tpu.llm.spec import build_proposer
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter, Gauge, Histogram, get_or_create
@@ -61,11 +62,18 @@ class LLMEngine:
         engine_config: Optional[EngineConfig] = None,
         params=None,
         seed: int = 0,
+        draft_params=None,
     ):
         self.model_config = model_config or GPTConfig()
         self.engine_config = engine_config or EngineConfig()
         self.runner = GPTRunner(
             self.model_config, self.engine_config, params=params, seed=seed
+        )
+        # Speculative decoding (ray_tpu.llm.spec): None when off. The
+        # proposer only produces guesses; _run_verify scores them against
+        # this engine's own model, so outputs never depend on it.
+        self._spec = build_proposer(
+            self.engine_config, seed=seed, draft_params=draft_params
         )
         self.allocator = BlockAllocator(
             self.engine_config.num_blocks,
@@ -139,6 +147,25 @@ class LLMEngine:
             "Requests failed in isolation after poisoning an engine step",
             tag_keys=("engine",),
         )
+        self._spec_proposed = get_or_create(
+            Counter,
+            "llm_engine_spec_proposed_tokens",
+            "Speculative tokens scored by the verify program",
+            tag_keys=("engine",),
+        )
+        self._spec_accepted = get_or_create(
+            Counter,
+            "llm_engine_spec_accepted_tokens",
+            "Speculative tokens that matched the target argmax and were "
+            "committed (excludes the always-emitted correction/bonus token)",
+            tag_keys=("engine",),
+        )
+        self._spec_acceptance = get_or_create(
+            Gauge,
+            "llm_engine_spec_acceptance_rate",
+            "Cumulative accepted / proposed speculative tokens",
+            tag_keys=("engine",),
+        )
         # Request-level latency histograms (the serving SLO trio + queue):
         # observed only at lifecycle boundaries, never per token.
         self._h_ttft = get_or_create(
@@ -173,8 +200,8 @@ class LLMEngine:
         self._h_step = get_or_create(
             Histogram,
             "llm_engine_step_seconds",
-            "One engine phase dispatch (prefill per sequence, decode per "
-            "batched step)",
+            "One engine phase dispatch (prefill per sequence, decode or "
+            "speculative verify per batched step)",
             boundaries=STEP_SECONDS_BOUNDARIES,
             tag_keys=("engine", "phase", "attn_impl"),
         )
@@ -196,7 +223,7 @@ class LLMEngine:
                     "n/a" if phase == "prefill" else self._attn_impl
                 ),
             }
-            for phase in ("prefill", "partial_prefill", "decode")
+            for phase in ("prefill", "partial_prefill", "decode", "verify")
         }
         # Observability plane (EngineConfig.instrument): per-request phase
         # spans + the per-step flight-recorder ring. The recorder object
@@ -207,7 +234,10 @@ class LLMEngine:
             self.engine_config.flight_recorder_capacity
         )
         self._req_traces: Dict[str, RequestTrace] = {}
-        if self._instrument:
+        if self._instrument or self._spec is not None:
+            # Preemption must also drop a stateful proposer's per-request
+            # resources (draft KV blocks) — the resume re-prefills both
+            # caches — so the hook installs whenever either plane needs it.
             self.scheduler.on_preempt = self._note_preempt
         # Poison-request isolation: records of requests failed in isolation
         # after an attributable step exception, newest last.
@@ -222,6 +252,10 @@ class LLMEngine:
         self._decode_slot_steps = 0
         self._prefill_tokens = 0
         self._cache_hit_tokens = 0
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._spec_emitted_total = 0
+        self._verify_steps = 0
         self._start = time.monotonic()
 
     # ---------------- request lifecycle ----------------
@@ -400,58 +434,32 @@ class LLMEngine:
             raise
 
         decoding = self.scheduler.schedule_decode()
-        t_decode = time.perf_counter() if instrument else 0.0
+        spec_info: Optional[dict] = None
         if decoding:
-            slots = ecfg.max_decode_slots
-            nb = ecfg.max_blocks_per_seq
-            tokens = np.zeros((slots,), np.int32)
-            positions = np.zeros((slots,), np.int32)
-            block_tables = np.zeros((slots, nb), np.int32)
-            context_lens = np.zeros((slots,), np.int32)
-            for i, seq in enumerate(decoding):
-                tokens[i] = seq.last_token
-                positions[i] = seq.num_cached
-                block_tables[i, : len(seq.block_table)] = seq.block_table
-                context_lens[i] = seq.num_cached
-            next_tokens = self.runner.decode(
-                tokens, positions, block_tables, context_lens
-            )
-            for i, seq in enumerate(decoding):
-                # Per-sequence section; placed before any mutation so a
-                # failure here leaves this sequence (and every later one,
-                # whose decode simply re-runs from unchanged state next
-                # step) consistent.
-                self._current_rid = seq.request.request_id
-                maybe_fail("llm.decode.seq", detail=seq.request.request_id)
-                seq.num_cached += 1
-                seq.generated.append(int(next_tokens[i]))
-                if seq.num_cached % ecfg.block_size == 0:
-                    # A block just filled: publish it to the prefix cache
-                    # before a finish below could release it.
-                    self.scheduler.note_filled_blocks(seq)
-                self._emit(seq)
-                self._maybe_finish(seq)
-            self._current_rid = None
-            self._decode_tokens += len(decoding)
-            self._decode_slot_steps += ecfg.max_decode_slots
-            if instrument:
-                # One observation per batched decode dispatch, never per
-                # token — the whole emission loop rides in it.
-                self._h_step.observe(
-                    time.perf_counter() - t_decode,
-                    tags=self._step_tags["decode"],
-                )
+            if self._spec is not None:
+                spec_info = self._run_verify(decoding)
+            if spec_info is None:
+                # Speculation off, or no sequence had proposals this step:
+                # the plain decode program is already compiled and exactly
+                # equivalent for one fed token per slot.
+                self._run_decode(decoding)
 
         self._steps += 1
         # A stepping engine exports its whole metric family: counters and
         # histograms that happen not to fire after a registry reset (test
         # isolation) must still re-register, or their series vanish from
         # the exposition. One int compare each — nothing on the token path.
-        for metric in (
+        family = (
             self._preemptions, self._prefix_hits, self._tokens_generated,
             self._dead_letter_count, self._h_ttft, self._h_tpot,
             self._h_queue, self._h_e2e, self._h_step,
-        ):
+        )
+        if self._spec is not None:
+            family = family + (
+                self._spec_proposed, self._spec_accepted,
+                self._spec_acceptance,
+            )
+        for metric in family:
             metric._ensure_registered()
         preempted = self.scheduler.num_preemptions - preempted_before
         if preempted:
@@ -471,28 +479,38 @@ class LLMEngine:
             self.allocator.num_evictable, tags=self._metric_tags
         )
         if instrument:
+            decode_label = "verify" if spec_info is not None else "decode"
             phase = "+".join(
                 p
-                for p, on in (("prefill", admitted), ("decode", decoding))
+                for p, on in (("prefill", admitted), (decode_label, decoding))
                 if on
             ) or "idle"
-            self.flight_recorder.record_step(
-                {
-                    "step": self._steps - 1,
-                    "phase": phase,
-                    "attn_impl": self._attn_impl,
-                    "batch_size": len(decoding),
-                    "num_prefills": len(admitted),
-                    "prefills": prefill_info,
-                    "tokens_in": sum(p["tokens"] for p in prefill_info),
-                    "tokens_out": len(admitted) + len(decoding),
-                    "cache_hit_tokens": step_hit_tokens,
-                    "preempted": preempted,
-                    "queue_depth": len(self.scheduler.waiting),
-                    "duration_s": round(time.perf_counter() - t_step_p, 6),
-                    "time": t_step,
-                }
-            )
+            record = {
+                "step": self._steps - 1,
+                "phase": phase,
+                "attn_impl": self._attn_impl,
+                "batch_size": len(decoding),
+                "num_prefills": len(admitted),
+                "prefills": prefill_info,
+                "tokens_in": sum(p["tokens"] for p in prefill_info),
+                "tokens_out": len(admitted)
+                + (
+                    spec_info["emitted"]
+                    if spec_info is not None
+                    else len(decoding)
+                ),
+                "cache_hit_tokens": step_hit_tokens,
+                "preempted": preempted,
+                "queue_depth": len(self.scheduler.waiting),
+                "duration_s": round(time.perf_counter() - t_step_p, 6),
+                "time": t_step,
+            }
+            if spec_info is not None:
+                # Verify record: which proposer ran, how wide the fed
+                # bucket was, and the proposed/accepted/emitted counts —
+                # the per-step acceptance story for the flight recorder.
+                record["speculation"] = spec_info
+            self.flight_recorder.record_step(record)
         return {
             "num_prefilled": len(admitted),
             "num_decoding": len(decoding),
@@ -502,6 +520,174 @@ class LLMEngine:
             "preempted": preempted,
             "cache_hit_tokens": step_hit_tokens,
             "evictable_blocks": self.allocator.num_evictable,
+        }
+
+    def _run_decode(self, decoding: List[Sequence]) -> None:
+        """One iteration-level decode dispatch: every running sequence
+        advances exactly one token through the batched decode program."""
+        ecfg = self.engine_config
+        instrument = self._instrument
+        t_decode = time.perf_counter() if instrument else 0.0
+        slots = ecfg.max_decode_slots
+        nb = ecfg.max_blocks_per_seq
+        tokens = np.zeros((slots,), np.int32)
+        positions = np.zeros((slots,), np.int32)
+        block_tables = np.zeros((slots, nb), np.int32)
+        context_lens = np.zeros((slots,), np.int32)
+        for i, seq in enumerate(decoding):
+            tokens[i] = seq.last_token
+            positions[i] = seq.num_cached
+            block_tables[i, : len(seq.block_table)] = seq.block_table
+            context_lens[i] = seq.num_cached
+        next_tokens = self.runner.decode(
+            tokens, positions, block_tables, context_lens
+        )
+        for i, seq in enumerate(decoding):
+            # Per-sequence section; placed before any mutation so a
+            # failure here leaves this sequence (and every later one,
+            # whose decode simply re-runs from unchanged state next
+            # step) consistent.
+            self._current_rid = seq.request.request_id
+            maybe_fail("llm.decode.seq", detail=seq.request.request_id)
+            seq.num_cached += 1
+            seq.generated.append(int(next_tokens[i]))
+            if seq.num_cached % ecfg.block_size == 0:
+                # A block just filled: publish it to the prefix cache
+                # before a finish below could release it.
+                self.scheduler.note_filled_blocks(seq)
+            self._emit(seq)
+            self._maybe_finish(seq)
+        self._current_rid = None
+        self._decode_tokens += len(decoding)
+        self._decode_slot_steps += ecfg.max_decode_slots
+        if instrument:
+            # One observation per batched decode dispatch, never per
+            # token — the whole emission loop rides in it.
+            self._h_step.observe(
+                time.perf_counter() - t_decode,
+                tags=self._step_tags["decode"],
+            )
+
+    def _run_verify(self, decoding: List[Sequence]) -> Optional[dict]:
+        """Speculative verify phase: ask the proposer for up to k tokens
+        per running sequence, score them all in ONE target-model step
+        (GPTRunner.verify — the partial-prefill shape batched over the
+        decode slots), accept each sequence's longest proposal prefix that
+        agrees with the target argmax plus the correction/bonus token, and
+        roll back the rejected tail (Scheduler.rollback: context-length
+        rewind + block-table trim). Emits 1..k+1 tokens per sequence per
+        step; greedy outputs are token-identical to the plain decode loop
+        by construction (out[i] IS the token decode would have produced).
+
+        Returns the flight-recorder speculation record, or None when no
+        sequence had usable proposals this step — the caller then runs the
+        plain (already-compiled) decode program, which is exactly
+        equivalent for one fed token per slot."""
+        ecfg = self.engine_config
+        instrument = self._instrument
+        # Clock starts before the proposer: proposal cost (draft-model
+        # steps, host-side matching) is part of what the verify phase
+        # must amortize, so it belongs in the phase=verify histogram.
+        t_verify = time.perf_counter() if instrument else 0.0
+        k = ecfg.num_speculative_tokens
+        proposals = self._spec.propose(decoding, k)
+        plans: List[List[int]] = []
+        max_fed = 1
+        for seq, props in zip(decoding, proposals):
+            props = [int(t) for t in props[:k]]
+            # Never speculate past the request budget (the bonus token
+            # must still fit) or the cache capacity; blocks are reserved
+            # opportunistically — speculation never preempts a neighbor.
+            cap = min(
+                len(props),
+                seq.request.max_new_tokens - len(seq.generated) - 1,
+                ecfg.max_model_len - seq.num_cached - 1,
+            )
+            props = props[: max(cap, 0)]
+            if props:
+                props = props[
+                    : self.scheduler.reserve_speculative(seq, len(props))
+                ]
+            plans.append(props)
+            max_fed = max(max_fed, 1 + len(props))
+        if max_fed == 1:
+            return None
+        s_bucket = ecfg.verify_bucket_for(max_fed)
+        slots = ecfg.max_decode_slots
+        nb = ecfg.max_blocks_per_seq
+        tokens = np.zeros((slots, s_bucket), np.int32)
+        block_tables = np.zeros((slots, nb), np.int32)
+        context_lens = np.zeros((slots,), np.int32)
+        true_lens = np.zeros((slots,), np.int32)
+        for i, (seq, props) in enumerate(zip(decoding, plans)):
+            tokens[i, 0] = seq.last_token
+            if props:
+                tokens[i, 1 : 1 + len(props)] = props
+            block_tables[i, : len(seq.block_table)] = seq.block_table
+            context_lens[i] = seq.num_cached
+            true_lens[i] = 1 + len(props)
+        out = self.runner.verify(
+            tokens, block_tables, context_lens, true_lens
+        )
+        proposed = accepted = emitted = 0
+        for i, (seq, props) in enumerate(zip(decoding, plans)):
+            # Per-sequence commit section; nothing mutates before the
+            # injection point, so a poisoned request dead-letters alone
+            # and an unattributable failure retries the whole step from
+            # consistent state (propose() is deterministic on retry).
+            rid = seq.request.request_id
+            self._current_rid = rid
+            maybe_fail("engine.verify", detail=rid)
+            base = seq.num_cached
+            n_ok = 0
+            while n_ok < len(props) and int(out[i, n_ok]) == props[n_ok]:
+                n_ok += 1
+            # out[i, n_ok] is the correction after a mismatch, or the
+            # bonus token when every proposal matched — either way the
+            # target's own argmax, so it is always committed.
+            new_tokens = props[:n_ok] + [int(out[i, n_ok])]
+            eos_id = seq.request.eos_id
+            if eos_id is not None and eos_id in new_tokens:
+                new_tokens = new_tokens[: new_tokens.index(eos_id) + 1]
+            self.scheduler.rollback(seq, base + len(new_tokens))
+            seq.generated.extend(new_tokens)
+            self.scheduler.note_filled_blocks(seq)
+            proposed += len(props)
+            # Accepted = proposed tokens actually COMMITTED: an eos inside
+            # the matched prefix truncates the commit, and the counter
+            # must not claim the dropped tail.
+            accepted += min(n_ok, len(new_tokens))
+            emitted += len(new_tokens)
+            self._emit(seq)
+            self._maybe_finish(seq)
+        self._current_rid = None
+        self._decode_tokens += emitted
+        self._decode_slot_steps += slots
+        self._verify_steps += 1
+        self._spec_proposed_total += proposed
+        self._spec_accepted_total += accepted
+        self._spec_emitted_total += emitted
+        if proposed:
+            self._spec_proposed.inc(proposed, tags=self._metric_tags)
+        if accepted:
+            self._spec_accepted.inc(accepted, tags=self._metric_tags)
+        self._spec_acceptance.set(
+            self._spec_accepted_total / max(self._spec_proposed_total, 1),
+            tags=self._metric_tags,
+        )
+        if instrument:
+            # One observation per batched verify dispatch (proposer +
+            # program + the whole commit loop), never per token.
+            self._h_step.observe(
+                time.perf_counter() - t_verify,
+                tags=self._step_tags["verify"],
+            )
+        return {
+            "mode": self._spec.name,
+            "fed_bucket": s_bucket,
+            "proposed": proposed,
+            "accepted": accepted,
+            "emitted": emitted,
         }
 
     def _run_prefills(
@@ -607,14 +793,24 @@ class LLMEngine:
             self._finished(seq)
 
     def _note_preempt(self, seq: Sequence) -> None:
-        """Scheduler preemption hook: close the victim's decode-stretch
-        span, mark the preemption, and restart its queue-wait clock."""
+        """Scheduler preemption hook: drop the proposer's per-request
+        state (a stateful proposer's draft blocks must not outlive the
+        victim's own KV blocks — the resume re-prefills both caches),
+        then close the victim's decode-stretch span, mark the preemption,
+        and restart its queue-wait clock."""
+        if self._spec is not None:
+            self._spec.release(seq.request.request_id)
         rt = self._req_traces.get(seq.request.request_id)
         if rt is not None:
             rt.on_preempt(time.time(), len(seq.generated))
 
     def _finished(self, seq: Sequence) -> None:
         req_id = seq.request.request_id
+        if self._spec is not None:
+            # Terminal for any reason (finish, abort, dead-letter): the
+            # proposer's per-request resources (draft KV blocks) go with
+            # the request's own KV blocks.
+            self._spec.release(req_id)
         self._on_token.pop(req_id, None)
         rt = self._req_traces.pop(req_id, None)
         if rt is not None:
@@ -683,6 +879,21 @@ class LLMEngine:
             "prefix_cache_evictions": self.allocator.num_evictions,
             "cow_blocks": self.scheduler.num_cow_blocks,
             "num_dead_letters": len(self._dead_letters),
+            "speculation": (
+                self._spec.name if self._spec is not None else "off"
+            ),
+            "spec_proposed_tokens": self._spec_proposed_total,
+            "spec_accepted_tokens": self._spec_accepted_total,
+            "spec_acceptance_rate": (
+                self._spec_accepted_total
+                / max(self._spec_proposed_total, 1)
+            ),
+            "spec_verify_steps": self._verify_steps,
+            # > 1.0 means verification is amortizing decode steps: tokens
+            # emitted per verify-program dispatch, correction included.
+            "spec_tokens_per_verify_step": (
+                self._spec_emitted_total / max(self._verify_steps, 1)
+            ),
             "uptime_s": elapsed,
         }
 
@@ -716,9 +927,11 @@ class LLMServer:
         params=None,
         seed: int = 0,
         warmup: bool = True,
+        draft_params=None,
     ):
         self._engine = LLMEngine(
-            model_config, engine_config, params=params, seed=seed
+            model_config, engine_config, params=params, seed=seed,
+            draft_params=draft_params,
         )
         if warmup:
             # Compile every prefill bucket and the decode program now, while
@@ -729,12 +942,25 @@ class LLMServer:
             # instrumentation so multi-second XLA compiles don't land in the
             # TTFT/e2e SLO histograms or the trace buffer (the flight
             # recorder's compile events capture warmup cost instead).
+            # Speculation is suppressed too: the generate-based warmup
+            # rounds must deterministically exercise every prefill/decode
+            # bucket (an all-zeros prompt is maximally repetitive, so the
+            # n-gram proposer would reroute them through verify); the
+            # verify buckets get their own dedicated compile pass below.
             instrumented = self._engine._instrument
+            spec = self._engine._spec
             self._engine._instrument = False
+            # ray-tpu: lint-ignore[RTL403] deliberate temporary clear —
+            # the finally below restores _spec on every path, so no
+            # exception can skip the consumer of the saved value
+            self._engine._spec = None
             try:
                 self._warmup()
             finally:
                 self._engine._instrument = instrumented
+                self._engine._spec = spec
+            if spec is not None:
+                self._warmup_verify(spec)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._requests: Dict[str, _RequestState] = {}
@@ -806,6 +1032,34 @@ class LLMServer:
                     time.monotonic() - t0,
                 )
             alloc.reset_prefix_cache()
+
+    def _warmup_verify(self, spec) -> None:
+        """Compile every k-token verify bucket program plus whatever the
+        proposer owns (the draft model's prefill/decode programs), so the
+        first speculative step under live traffic never cold-compiles.
+        The synthetic verify calls run against all-null block tables:
+        writes land in the null block (the masked-lane convention) and
+        touch no allocator state."""
+        ecfg = self._engine.engine_config
+        runner = self._engine.runner
+        slots = ecfg.max_decode_slots
+        nb = ecfg.max_blocks_per_seq
+        for s_bucket in ecfg.verify_buckets():
+            t0 = time.monotonic()
+            runner.verify(
+                np.zeros((slots, s_bucket), np.int32),
+                np.zeros((slots, nb), np.int32),
+                np.zeros((slots,), np.int32),
+                np.full((slots,), s_bucket, np.int32),
+            )
+            self._engine.flight_recorder.record_compile(
+                "verify", s_bucket, time.monotonic() - t0
+            )
+        t0 = time.monotonic()
+        spec.warmup()
+        self._engine.flight_recorder.record_compile(
+            f"proposer:{spec.name}", 0, time.monotonic() - t0
+        )
 
     # ---------------- engine loop ----------------
 

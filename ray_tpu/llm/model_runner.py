@@ -5,7 +5,11 @@ batch, fixed block-table width), one full-prefill program per power-of-two
 bucket, one *partial*-prefill program per bucket (prefix caching: feed only
 the uncached suffix at a position offset and attend to the cached prefix
 through the block table — paged attention over the prefix, causal over the
-suffix), and one block-to-block copy (copy-on-write for shared blocks).
+suffix), one block-to-block copy (copy-on-write for shared blocks), and —
+with speculative decoding on — one batched k-token verify program per fed
+width bucket (the partial-prefill shape generalized to [max_decode_slots]
+slots with per-slot position offsets, returning the argmax at EVERY fed
+position so the engine can accept the longest agreeing proposal prefix).
 The cache pools are [L, num_blocks, block_size, H, D] device arrays
 threaded functionally through every step with donated buffers, so steps
 update the cache in place without host round-trips.
@@ -100,6 +104,9 @@ class GPTRunner:
             self.v_scale = None
         self._decode_fn = jax.jit(
             self._decode_step, donate_argnums=(1, 2, 3, 4)
+        )
+        self._verify_fn = jax.jit(
+            self._verify_step, donate_argnums=(1, 2, 3, 4)
         )
         self._prefill_fn = jax.jit(
             self._prefill_step, donate_argnums=(1, 2, 3, 4)
@@ -311,6 +318,93 @@ class GPTRunner:
                 v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         next_tokens = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
         return (k_cache, v_cache, k_scale, v_scale), next_tokens
+
+    # ---------------- k-token verification (speculative decoding) ----------
+
+    def _verify_step(
+        self, params, k_cache, v_cache, k_scale, v_scale, tokens,
+        block_tables, context_lens, true_lens,
+    ):
+        """Batched multi-token scoring for speculative decoding. tokens
+        [B, S] = each slot's last committed token followed by its proposed
+        tokens (0-padded past true_lens[b]); block_tables [B, nb];
+        context_lens [B] = committed K/V per slot; true_lens [B] = fed
+        tokens per slot (1 + that slot's proposals) → (pools, out [B, S]).
+
+        The batched generalization of the partial-prefill program: slot b's
+        fed tokens sit at absolute positions context_lens[b] + lane, attend
+        the committed prefix through the block table (paged) and each other
+        causally, and their K/V is scattered at those positions — so
+        out[b, i], the argmax after consuming fed tokens 0..i, is exactly
+        the token the plain decode loop would have produced at that point.
+        int8 caveat: lanes attend EACH OTHER through their fresh
+        full-precision K/V (new_k/new_v), while sequential decode reads
+        the same tokens back quantized — the identical caveat partial
+        prefill already carries — so under kv_cache_dtype="int8" the
+        equivalence is within quantization tolerance (argmax-identical on
+        the tested prompt set, not bit-guaranteed), exactly int8's own
+        contract.
+        Padded lanes (lane >= true_lens[b]) scatter into the null block and
+        their outputs are garbage the engine never reads. The engine
+        commits the longest proposal prefix agreeing with `out` and rolls
+        the rest back (Scheduler.rollback); rejected lanes' K/V stays
+        masked above the rewound context length."""
+        cfg, ecfg = self.model_config, self.engine_config
+        b, s = tokens.shape
+        lane = jnp.arange(s)[None, :]
+        valid = lane < true_lens[:, None]  # [B, S]
+        positions = jnp.where(valid, context_lens[:, None] + lane, 0)
+        logits, state = self.model.apply(
+            params,
+            tokens,
+            positions=positions,
+            paged_caches=self._paged_caches(
+                k_cache, v_cache, k_scale, v_scale, block_tables,
+                context_lens,
+            ),
+            paged_impl=self.attn_impl,
+            mutable=["intermediates"],
+        )
+        kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+        bs = ecfg.block_size
+        rows = jnp.arange(b)[:, None]
+        block_ids = jnp.where(
+            valid, block_tables[rows, positions // bs], 0
+        )
+        offsets = jnp.where(valid, positions % bs, 0)
+        for layer, (k, v) in enumerate(kvs):
+            kq, ks = self._store_kv(k)
+            vq, vs = self._store_kv(v)
+            k_cache = k_cache.at[layer, block_ids, offsets].set(kq)
+            v_cache = v_cache.at[layer, block_ids, offsets].set(vq)
+            if ks is not None:
+                k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
+                v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (k_cache, v_cache, k_scale, v_scale), out
+
+    def verify(
+        self,
+        tokens: np.ndarray,
+        block_tables: np.ndarray,
+        context_lens: np.ndarray,
+        true_lens: np.ndarray,
+    ) -> np.ndarray:
+        """Score up to S-1 proposed tokens per slot in one step (see
+        _verify_step). Arrays must already be padded to
+        [max_decode_slots, S_bucket] / [max_decode_slots, max_blocks_per_seq]
+        / [max_decode_slots]; one program compiles per S bucket
+        (EngineConfig.verify_buckets)."""
+        pools, out = self._verify_fn(
+            self.params,
+            *self._pools,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(true_lens, jnp.int32),
+        )
+        self._set_pools(pools)
+        return np.asarray(out)
 
     def decode(
         self,

@@ -14,9 +14,11 @@ Two pieces the engine hooks into (gated by EngineConfig.instrument):
 
   * FlightRecorder — a bounded ring of structured per-step records (step
     index, phase, batch size, tokens in/out, buckets, prefix-cache hits,
-    preemptions, duration) plus warmup compile events (cold-compile blame)
-    and step failures from the PR 3 poison-isolation path. Exposed through
-    LLMServer.flight_record() and the dashboard /api/llm panel.
+    preemptions, duration; with speculative decoding on, verify steps add
+    a "speculation" record — proposer mode, fed bucket, proposed /
+    accepted / emitted counts) plus warmup compile events (cold-compile
+    blame) and step failures from the PR 3 poison-isolation path. Exposed
+    through LLMServer.flight_record() and the dashboard /api/llm panel.
 
 The request latency histograms live here too so every engine shares one
 registered metric per name (vLLM reports the same trio — TTFT, time per

@@ -235,6 +235,59 @@ class Scheduler:
                         break
         return list(self.running)
 
+    def reserve_speculative(self, seq: Sequence, num_tokens: int) -> int:
+        """Extend `seq`'s block table so a verify step can write K/V for
+        its next token PLUS up to `num_tokens` speculative tokens
+        (positions num_cached .. num_cached + num_tokens). Speculation is
+        opportunistic: it never preempts another sequence for blocks —
+        on pool pressure (or the per-sequence block/length caps) the count
+        is shrunk, down to 0 (plain decode). Returns the number of
+        speculative tokens actually covered; the caller feeds exactly
+        1 + that many tokens. Call after schedule_decode(), which already
+        guaranteed the plain-decode block."""
+        bs = self.allocator.block_size
+        # Length cap: the furthest write lands at position
+        # num_cached + num_tokens, which must stay inside the table.
+        num_tokens = min(
+            num_tokens, self.max_blocks_per_seq * bs - seq.num_cached - 1
+        )
+        while num_tokens > 0:
+            extra = (
+                blocks_for_tokens(seq.num_cached + 1 + num_tokens, bs)
+                - len(seq.block_table)
+            )
+            if extra <= 0:
+                return num_tokens
+            if self.allocator.can_allocate(extra):
+                seq.block_table.extend(self.allocator.allocate(extra))
+                return num_tokens
+            num_tokens -= 1
+        return 0
+
+    def rollback(self, seq: Sequence, num_cached: int) -> None:
+        """Commit + roll back after a verify step: `num_cached` becomes the
+        count of tokens whose K/V is valid in the cache (the accepted
+        prefix of what the verify program scattered), and the speculative
+        tail blocks past the committed region are freed. Rejected tokens'
+        K/V stays in the kept blocks as garbage above num_cached — every
+        attention masks positions >= context_len, and the next write
+        overwrites it. Trimmed blocks were never published to the prefix
+        cache (only full blocks at or below num_cached get chain keys), so
+        they return to the plain free list."""
+        covered = len(seq.block_table) * self.allocator.block_size
+        if num_cached > covered:
+            raise ValueError(
+                f"rollback target {num_cached} exceeds the {covered} "
+                "tokens this sequence's block table covers — the verify "
+                "step cannot have written there"
+            )
+        seq.num_cached = num_cached
+        keep = blocks_for_tokens(num_cached, self.allocator.block_size)
+        if len(seq.block_table) > keep:
+            tail = seq.block_table[keep:]
+            del seq.block_table[keep:]
+            self.allocator.free(tail)
+
     def preempt(self, seq: Sequence) -> None:
         """Recompute-style preemption: free the blocks, fold generated
         tokens into the prompt, and put the sequence at the front of the

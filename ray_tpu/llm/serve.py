@@ -25,8 +25,11 @@ def get_or_create_engine_actor(
     params=None,
     seed: int = 0,
     max_concurrency: int = 32,
+    draft_params=None,
 ):
-    """Named engine actor shared by every ingress replica."""
+    """Named engine actor shared by every ingress replica. With
+    `engine_config.speculation="draft"`, `draft_params` carries the draft
+    model's trained weights (seed-initialized otherwise)."""
     return (
         ray_tpu.remote(LLMServer)
         .options(
@@ -34,7 +37,10 @@ def get_or_create_engine_actor(
             get_if_exists=True,
             max_concurrency=max_concurrency,
         )
-        .remote(model_config, engine_config, params, seed)
+        .remote(
+            model_config, engine_config, params, seed,
+            draft_params=draft_params,
+        )
     )
 
 
@@ -86,9 +92,11 @@ class LLMIngress:
         engine_config: Optional[EngineConfig] = None,
         params=None,
         seed: int = 0,
+        draft_params=None,
     ):
         self._engine = get_or_create_engine_actor(
-            engine_name, model_config, engine_config, params=params, seed=seed
+            engine_name, model_config, engine_config, params=params,
+            seed=seed, draft_params=draft_params,
         )
 
     def __call__(self, request: dict):
@@ -129,6 +137,18 @@ class LLMIngress:
         """The engine flight recorder (see LLMServer.flight_record):
         per-step records, warmup compile events, and step failures."""
         return ray_tpu.get(self._engine.flight_record.remote(steps_limit))
+
+    def observability_snapshot(
+        self, steps_limit: Optional[int] = None
+    ) -> dict:
+        """metrics + dead letters + flight recorder in one engine round
+        trip (see LLMServer.observability_snapshot) — with speculation on,
+        the metrics carry the acceptance-rate story (spec_acceptance_rate,
+        spec_tokens_per_verify_step) and the step records the per-step
+        proposed/accepted counts."""
+        return ray_tpu.get(
+            self._engine.observability_snapshot.remote(steps_limit)
+        )
 
     def reset_prefix_cache(self) -> None:
         """Drop the engine's cached-but-unreferenced KV blocks (call after
@@ -172,6 +192,7 @@ def build_app(
     num_replicas: int = 1,
     max_concurrent_queries: int = 32,
     seed: int = 0,
+    draft_params=None,
 ) -> serve.Application:
     """Bind the LLM ingress for `serve.run` (HTTP via the existing proxy:
     POST /<app> with the request JSON). Pass trained weights via `params`;
@@ -191,5 +212,6 @@ def build_app(
         max_concurrent_queries=max_concurrent_queries,
     )
     return deployment.bind(
-        engine_name, model_config, engine_config, params=params, seed=seed
+        engine_name, model_config, engine_config, params=params, seed=seed,
+        draft_params=draft_params,
     )

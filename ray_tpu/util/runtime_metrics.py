@@ -252,6 +252,12 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             "Cached-but-unreferenced KV blocks (reusable until evicted)",
             tag_keys=("engine",),
         ),
+        "spec_acceptance_rate": get_or_create(
+            Gauge,
+            "llm_engine_spec_acceptance_rate",
+            "Cumulative accepted / proposed speculative tokens",
+            tag_keys=("engine",),
+        ),
     }
     dead_letters = get_or_create(
         Gauge,
@@ -283,8 +289,20 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             )
             tags = {"engine": stats.get("engine_id") or name}
             for key, gauge in gauges.items():
-                if key in stats:
-                    gauge.set(float(stats[key]), tags=tags)
+                if key not in stats:
+                    continue
+                if (
+                    key == "spec_acceptance_rate"
+                    and stats.get("speculation", "off") == "off"
+                ):
+                    # stats() always carries the field (0.0 when
+                    # speculation is off); exporting it for
+                    # non-speculating engines would make "disabled"
+                    # indistinguishable from "0% acceptance" — mirror
+                    # the engine, which only registers spec series when
+                    # a proposer is configured.
+                    continue
+                gauge.set(float(stats[key]), tags=tags)
             dead_letters.set(float(stats.get("num_dead_letters", 0)), tags=tags)
             wedged.set(1.0 if stats.get("wedged") else 0.0, tags=tags)
         except Exception:

@@ -286,6 +286,63 @@ def test_unattributable_failure_below_threshold_recovers():
     server.shutdown()
 
 
+def test_verify_fault_dead_letters_only_culprit_releases_draft_blocks():
+    """Speculative decoding: an injected failure at the engine.verify
+    site (the per-sequence commit of a verify step) dead-letters ONLY the
+    culpable request — with its target KV blocks AND its draft-model
+    mirror blocks released — while every other in-flight generation
+    finishes token-identical to the unbatched reference and the KV pools
+    end exactly as they started."""
+    draft_cfg = GPTConfig(
+        vocab_size=128, num_layers=1, num_heads=4, embed_dim=64,
+        max_seq_len=128, dtype=jnp.float32, attention_impl="reference",
+    )
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4,
+        max_blocks_per_seq=8, speculation="draft",
+        draft_model_config=draft_cfg,
+    )
+    fi.inject(
+        "engine.verify",
+        match="poison-me",
+        exc_factory=lambda: RuntimeError("verify bitflip"),
+    )
+    server = LLMServer(TINY, ecfg, seed=0, warmup=False)
+    prompts = random_prompts((7, 6), seed=4)
+    jobs = [
+        ("ok-0", prompts[0], 10),
+        ("ok-1", prompts[1], 10),
+        ("poison-me", [3, 4, 5] * 4, 10),  # repetitive: speculation engages
+    ]
+    results = _concurrent_generates(server, jobs)
+    poisoned = results["poison-me"]
+    assert isinstance(poisoned, PoisonRequestError)
+    assert "verify bitflip" in repr(poisoned.cause)
+    model = GPT(TINY)
+    params = server._engine.runner.params
+    for rid, prompt in (("ok-0", prompts[0]), ("ok-1", prompts[1])):
+        out = results[rid]
+        assert not isinstance(out, BaseException), out
+        assert out["token_ids"] == reference_greedy(model, params, prompt, 10)
+    assert server.check_health() is True
+    letters = server.dead_letters()
+    assert [d["request_id"] for d in letters] == ["poison-me"]
+    # The step that died really was a verify step with proposals in it.
+    assert server._engine.stats()["spec_verify_steps"] > 0
+    # Pool-size invariants: every target KV block and every draft mirror
+    # block went back with its request — the pools are exactly as big as
+    # at boot, so repeated poisonings can never shrink serving capacity.
+    assert server._engine.allocator.num_allocated == 0
+    assert server._engine._spec.allocator.num_allocated == 0
+    assert server._engine._spec._state == {}
+    # The engine keeps speculating for new work afterwards.
+    out = server.generate([3, 4, 5] * 4, max_new_tokens=6, timeout_s=60.0)
+    assert out["token_ids"] == reference_greedy(
+        model, params, [3, 4, 5] * 4, 6
+    )
+    server.shutdown()
+
+
 # ---------------- router layer: failover + resume ----------------
 
 
@@ -392,6 +449,51 @@ def test_llm_stream_failover_injected_token_identical(serve_ray):
     tokens = [d["token_id"] for d in stream]
     assert spec.fires == 1  # the mid-stream death really happened
     assert tokens == want
+
+
+def test_spec_midstream_replica_kill_stream_resumes_token_identical(
+    serve_ray,
+):
+    """A replica dying mid-stream WHILE the engine is speculating resumes
+    on another replica token-identically: the resume re-submits prompt +
+    tokens-so-far, the engine rolls any in-flight speculative state back
+    with the aborted original, and the client-visible greedy stream stays
+    contiguous — speculation must never leak a rejected token into a
+    resumed stream."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4,
+        max_blocks_per_seq=8, prefill_buckets=(8, 32),
+        speculation="ngram",
+    )
+    handle = serve.run(
+        build_app(TINY, ecfg, engine_name="chaos-spec", num_replicas=2),
+        name="llmchaos5",
+    )
+    prompt = [5, 6, 7] * 4  # repetitive: the n-gram proposer engages
+    n_new = 9
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ecfg, seed=0).runner.params, prompt, n_new
+    )
+    spec = fi.inject(
+        "replica.stream_item",
+        nth=4,  # die after delivering 3 tokens, mid-speculation
+        exc_factory=lambda: ActorDiedError(None, "injected mid-spec kill"),
+    )
+    stream = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    tokens = [d["token_id"] for d in stream]
+    assert spec.fires == 1
+    assert tokens == want
+    # The engine really speculated around the failover.
+    engine = ray_tpu.get_actor("llm_engine:chaos-spec")
+    stats = ray_tpu.get(engine.metrics.remote())
+    assert stats["speculation"] == "ngram"
+    assert stats["spec_verify_steps"] > 0
+    assert stats["spec_accepted_tokens"] > 0
 
 
 def test_llm_stream_double_failover_token_identical(serve_ray):

@@ -1640,6 +1640,54 @@ def test_host_sync_item_in_while_loop_and_post_loop_exempt():
     assert "RTL503" not in rules_of(findings)
 
 
+def test_ngram_proposer_host_matching_in_step_loop_not_flagged():
+    """Speculative decoding's n-gram proposer is pure host-side token
+    matching on python lists — list slicing, comparisons, np.asarray of
+    host data — with no jitted result anywhere in its dataflow. Running
+    it inside the engine step loop (which also dispatches a jitted verify
+    step) must NOT read as a host-device sync: RTL503 is about syncing
+    the jitted result, not about the loop doing host work."""
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def match(history, k):
+            tail = history[-3:]
+            for start in range(len(history) - 4, -1, -1):
+                if history[start : start + 3] == tail:
+                    return history[start + 3 : start + 3 + k]
+            return []
+
+        def serve_loop(step_fn, params, histories, n):
+            step = jax.jit(step_fn)
+            for _ in range(n):
+                proposals = [match(h, 4) for h in histories]
+                batch = np.asarray([p + [0] * (4 - len(p)) for p in proposals])
+                params, out = step(params, batch)
+            return params, out
+        """
+    )
+    assert "RTL503" not in rules_of(findings)
+    # Positive control so the negative above can't be a dead rule: the
+    # same loop syncing the verify output per iteration IS the defect.
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def serve_loop(step_fn, params, histories, n):
+            step = jax.jit(step_fn)
+            accepted = []
+            for _ in range(n):
+                params, out = step(params, histories)
+                accepted.append(np.asarray(out))
+            return params, accepted
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+
 def test_host_sync_device_get_and_block_until_ready_flagged():
     findings = lint(
         """

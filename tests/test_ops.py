@@ -228,6 +228,53 @@ def test_paged_flash_int8_matches_int8_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(exact), atol=0.05)
 
 
+@pytest.mark.parametrize("variant", ["f32", "bf16", "int8"])
+def test_paged_flash_verify_shape_matches_reference(variant):
+    """Speculative-decoding verify shape: [B slots, S = k+1 fed tokens]
+    multi-query paged attention — paged over each slot's committed prefix,
+    causal among the fed (last + proposed) tokens — must agree between the
+    fused kernel and the XLA reference at the same context boundaries the
+    decode parity suite covers: 0 (no committed prefix), a block edge, the
+    full table, and a mid-block length, in bf16 and int8 as well as f32.
+    This is the program the engine's verify phase compiles, so it gets the
+    same oracle coverage as decode."""
+    s = 5  # num_speculative_tokens=4 -> 1 + 4 fed tokens
+    q, kc, vc, tables, nk, nv = _paged_case(8, b=4, s=s)
+    lens = jnp.asarray([0, 8, 16, 9], jnp.int32)
+    kwargs = {}
+    atol = 1e-5
+    if variant == "bf16":
+        q, kc, vc, nk, nv = (
+            x.astype(jnp.bfloat16) for x in (q, kc, vc, nk, nv)
+        )
+        atol = 5e-2  # bf16 storage/accumulation rounding
+    elif variant == "int8":
+        kc, ks = quantize_kv(kc)
+        vc, vs = quantize_kv(vc)
+        kwargs = dict(k_scale=ks, v_scale=vs)
+        atol = 2e-5
+    want = paged_attention(
+        q, kc, vc, tables, lens, new_k=nk, new_v=nv, **kwargs
+    )
+    got = paged_flash_attention(
+        q, kc, vc, tables, lens, new_k=nk, new_v=nv, **kwargs
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=atol
+    )
+    # Causality across the fed tokens: mutating the LAST fed token's K/V
+    # must not change any earlier fed position's output (the engine
+    # depends on this to accept a prefix while rejecting the tail).
+    nk2 = nk.at[:, -1].set(jnp.asarray(7.0, nk.dtype))
+    nv2 = nv.at[:, -1].set(jnp.asarray(-7.0, nv.dtype))
+    got2 = paged_flash_attention(
+        q, kc, vc, tables, lens, new_k=nk2, new_v=nv2, **kwargs
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got[:, : s - 1]), np.asarray(got2[:, : s - 1])
+    )
+
+
 def test_quantize_kv_round_trip():
     """Per-token int8 quantization: sub-1% round-trip error, exact-zero
     preservation, and int8 range discipline."""
