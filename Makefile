@@ -4,13 +4,19 @@
 
 PY ?= python
 
-.PHONY: lint lint-sarif lint-json test test-lint
+.PHONY: lint lint-changed lint-sarif lint-json test test-lint
 
 # Tree-clean gate: exit 1 on any active finding, untriaged baseline
 # entry, stale baseline entry, or parse error. Same entry point as the
 # `ray-tpu-lint` console script and `ray-tpu lint`.
 lint:
 	$(PY) -m ray_tpu.tools.lint ray_tpu
+
+# Pre-commit loop: everything is parsed (the cross-module pass needs
+# the whole tree) but rules run only on files changed vs git HEAD plus
+# their reverse import dependents from the project model.
+lint-changed:
+	$(PY) -m ray_tpu.tools.lint ray_tpu --changed
 
 # CI annotation feed (SARIF 2.1.0 — GitHub code scanning et al.).
 lint-sarif:

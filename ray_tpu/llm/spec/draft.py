@@ -204,7 +204,17 @@ class DraftModelProposer(Proposer):
         delta = ids[st.num_cached :]
         try:
             if st.num_cached == 0:
-                first = self.runner.prefill(ids, st.block_table)
+                # The mirror table is sized for the committed tokens
+                # PLUS the proposal chain (_reserve), but the prefill
+                # program's block vector holds exactly bucket_for(n) //
+                # block_size entries — feed only the blocks the tokens
+                # occupy, or the scatter buffer rejects the extra ids
+                # and the except below silently skips proposing
+                # whenever n sits at a bucket boundary and the chain
+                # spills into the next block (first contact and every
+                # post-release re-prefill).
+                nb = blocks_for_tokens(n, self.allocator.block_size)
+                first = self.runner.prefill(ids, st.block_table[:nb])
             else:
                 first = self.runner.prefill_suffix(
                     delta, st.block_table, st.num_cached

@@ -1,11 +1,14 @@
 """ray-tpu lint: codebase-aware static analyzer.
 
-Seven rule families tuned to this repo's hazard classes (every one of
+Eight rule families tuned to this repo's hazard classes (every one of
 which previously shipped a hand-found bug — see CHANGES.md). The first
-four are per-module; the last three ride the PROJECT-LEVEL pass
+four are per-module; the next three ride the PROJECT-LEVEL pass
 (`project.py`): a cross-module symbol table (import-alias chains,
 `__init__.py` re-exports), a call graph, and an actor-method index, so
-resolution follows code across files:
+resolution follows code across files. The eighth runs an ABSTRACT
+INTERPRETER (`shapes.py`) over jitted programs — symbolic shapes,
+dtypes and shardings, with TOP for anything unmodeled so unknowns can
+never fire:
 
   * async (RTL1xx)     — blocking calls in `async def`, await while
                          holding a threading lock, unawaited coroutines
@@ -24,10 +27,16 @@ resolution follows code across files:
                          mesh, collectives naming unbound axis names
   * actors (RTL7xx)    — blocking get on a same-actor task, synchronous
                          cross-actor call cycles (graph SCCs)
+  * shapes (RTL8xx)    — abstract shape/dtype/sharding interpretation:
+                         geometry contradictions at jitted call sites,
+                         donation that degrades to a copy, PartitionSpec
+                         divisibility, int8 pool/scale pairing, bucket-
+                         table coverage drift (guaranteed cold compiles)
 
 Entry points: `ray-tpu lint`, `python -m ray_tpu.tools.lint`, `make
-lint`, or `lint_source()` / `lint_sources()` / `lint_paths()` from
-Python (tests use all three).
+lint` (`make lint-changed` for the diff-scoped pre-commit loop), or
+`lint_source()` / `lint_sources()` / `lint_paths()` from Python (tests
+use all three).
 """
 
 from ray_tpu.tools.lint.core import (  # noqa: F401

@@ -2,23 +2,24 @@
 
     ray-tpu lint [paths ...] [--rule ID] [--json] [--sarif]
                  [--baseline FILE] [--write-baseline] [--list-rules]
-                 [--no-baseline] [--explain RULE]
+                 [--no-baseline] [--explain RULE] [--changed]
 
 Exit codes: 0 — clean (every finding fixed, suppressed with a reason, or
 baselined with a reason); 1 — active findings (or untriaged baseline
 entries); 2 — usage/parse errors.
 
 `--json` emits a machine-readable report (consumed by the dashboard and
-tests). `version` is the SCHEMA version — bumped to 2 with the
-project-level pass (new keys never appear under an old version number,
-so consumers can gate on it):
+tests). `version` is the SCHEMA version — bumped to 3 with the
+diff-scoped scan (`files_checked` key; new keys never appear under an
+old version number, so consumers can gate on it):
 
     {
-      "version": 2,
-      "schema": "ray-tpu-lint-report/2",
+      "version": 3,
+      "schema": "ray-tpu-lint-report/3",
       "root": "/abs/repo",
       "paths": ["ray_tpu"],
       "files_scanned": 240,
+      "files_checked": 240,
       "duration_s": 1.8,
       "counts": {"active": 0, "baselined": 12, "suppressed": 4,
                  "parse_errors": 0, "stale_baseline": 0,
@@ -42,15 +43,22 @@ survives line drift. Exit codes match the other modes.
 `--explain RULE` prints the rule's rationale plus a minimal bad/good
 example pair — the SAME snippets the fixture tests run, so the examples
 can never drift from what the rule flags.
+
+`--changed` scopes the scan to the files changed vs git HEAD (tracked
+modifications plus untracked .py files) AND their reverse import
+dependents from the project model — everything is still parsed so the
+cross-module symbol table sees the whole tree, but rules run only on
+the diff closure. That is the pre-commit loop: `make lint-changed`.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from ray_tpu.tools.lint import baseline as baseline_mod
 from ray_tpu.tools.lint.core import (
@@ -107,7 +115,44 @@ def build_parser() -> argparse.ArgumentParser:
         "--explain", default=None, metavar="RULE",
         help="print a rule's rationale + minimal bad/good example",
     )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help=(
+            "check only files changed vs git HEAD plus their reverse "
+            "import dependents (the whole tree is still parsed for the "
+            "cross-module pass)"
+        ),
+    )
     return parser
+
+
+def _git_changed_files(root: Path) -> Optional[Set[str]]:
+    """LINT-root-relative posix paths of changed .py files: tracked
+    changes vs HEAD plus untracked (not ignored) files. None when git
+    is unavailable or `root` is not inside a work tree. `--relative`
+    matters: the lint root (pyproject.toml) may be a SUBDIRECTORY of
+    the git toplevel, and module relpaths are computed against the lint
+    root — without it, diff paths come back toplevel-relative, nothing
+    matches, and a monorepo pre-commit run would silently check zero
+    files."""
+    out: Set[str] = set()
+    for cmd in (
+        ["git", "-C", str(root), "diff", "--name-only", "--relative",
+         "HEAD"],
+        ["git", "-C", str(root), "ls-files", "--others",
+         "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, check=True
+            )
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        out.update(
+            line.strip() for line in proc.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return out
 
 
 SARIF_SCHEMA = (
@@ -237,8 +282,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         {} if args.no_baseline else baseline_mod.load_baseline(baseline_path)
     )
 
+    changed: Optional[Set[str]] = None
+    if args.changed:
+        changed = _git_changed_files(root)
+        if changed is None:
+            print(
+                "ray-tpu lint: --changed needs a git work tree at "
+                f"{root}", file=sys.stderr,
+            )
+            return 2
+
     result = lint_paths(
-        paths, rule_ids=args.rule, baseline=baseline, root=root
+        paths, rule_ids=args.rule, baseline=baseline, root=root,
+        changed_only=changed,
     )
 
     if args.write_baseline:
@@ -258,29 +314,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             else:
                 existing[f.fingerprint] = baseline_mod.entry_for(f)
                 new += 1
-        # Drop stale entries (the finding no longer exists) — but only
-        # those this run could have re-produced: a scan scoped by path or
-        # --rule must not discard the rest of the baseline, and a file
-        # that failed to PARSE this run produced no findings at all, so
-        # its triaged entries (and their written reasons) must survive.
+        # Drop stale entries (the finding no longer exists) — but ONLY
+        # those this run could have re-produced: an entry is in scope
+        # exactly when its rule was in the scanned rule set AND its
+        # file was in the CHECKED set (rules actually ran on it). A
+        # scan scoped by path, --rule or --changed must not discard the
+        # rest of the baseline — a narrowed run re-fingerprints only
+        # what it checked, so everything else (other families, other
+        # files, their written reasons) survives verbatim. A file that
+        # failed to PARSE this run produced no findings at all, so its
+        # triaged entries survive too.
         produced = {f.fingerprint for f in result.findings} | {
             f.fingerprint for f, _ in result.baselined
         }
         parse_failed = {f.path for f in result.parse_errors}
-        scan_roots = [p.resolve() for p in paths]
         wanted = set(args.rule) if args.rule else None
         scanned_rules = {
             r.id for r in all_rules()
             if wanted is None or r.id in wanted or r.name in wanted
         }
+        # The meta findings are produced outside the registry: RTL002
+        # on every run, RTL003 only on full-registry runs — their stale
+        # entries are droppable exactly then.
+        scanned_rules.add("RTL002")
+        if wanted is None:
+            scanned_rules.add("RTL003")
 
         def in_scope(entry: dict) -> bool:
-            if entry["rule"] not in scanned_rules:
-                return False
-            entry_path = (root / entry["path"]).resolve()
-            return any(
-                entry_path == sr or sr in entry_path.parents
-                for sr in scan_roots
+            return (
+                entry.get("rule") in scanned_rules
+                and entry.get("path") in result.checked_relpaths
             )
 
         entries = [
@@ -308,11 +371,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(sarif_report(result, root), indent=2))
     elif args.json:
         report = {
-            "version": 2,
-            "schema": "ray-tpu-lint-report/2",
+            "version": 3,
+            "schema": "ray-tpu-lint-report/3",
             "root": str(root),
             "paths": [str(p) for p in paths],
             "files_scanned": result.files_scanned,
+            "files_checked": len(result.checked_relpaths),
             "duration_s": round(result.duration_s, 3),
             "counts": {
                 "active": len(result.findings),
@@ -345,12 +409,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"{entry['path']}:{entry.get('line', 0)}: {entry['rule']} "
                 f"baseline entry has no written reason ({entry['reason']!r})"
             )
+        scope = (
+            f"{len(result.checked_relpaths)} changed(+dependents) of "
+            f"{result.files_scanned} files"
+            if args.changed
+            else f"{result.files_scanned} files"
+        )
         summary = (
             f"{len(result.findings)} finding(s), "
             f"{len(result.baselined)} baselined, "
             f"{len(result.suppressed)} suppressed, "
             f"{len(result.parse_errors)} parse error(s) in "
-            f"{result.files_scanned} files "
+            f"{scope} "
             f"({result.duration_s:.2f}s)"
         )
         if result.stale_baseline:

@@ -29,7 +29,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 FAMILIES = (
     "meta", "async", "locks", "trace", "resources",
-    "donation", "sharding", "actors",
+    "donation", "sharding", "actors", "shapes",
 )
 
 SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist", "node_modules"}
@@ -448,6 +448,27 @@ def _module_scope_bindings(module: ModuleInfo) -> Dict[str, ast.AST]:
     return out
 
 
+def _scope_binding_index(
+    module: ModuleInfo, scope: ast.AST
+) -> Dict[str, List[ast.AST]]:
+    """name -> binding statements at `scope` level, memoized per scope.
+    `resolve_name_binding` is on the hot path of the call graph AND the
+    RTL8xx abstract interpreter; re-walking a scope's statements per
+    lookup was the dominant cost of a full scan."""
+    memo = module.memo.setdefault("scope_binding_index", {})
+    cached = memo.get(id(scope))
+    if cached is not None:
+        return cached
+    index: Dict[str, List[ast.AST]] = {}
+    for node in _scope_level_nodes(scope):
+        # _bound_names answers exactly the names _binding_of binds
+        # (its docstring points back at the predicate).
+        for name in _bound_names(node):
+            index.setdefault(name, []).append(node)
+    memo[id(scope)] = index
+    return index
+
+
 def resolve_name_binding(
     module: ModuleInfo, name: str, at: ast.AST
 ) -> Optional[ast.AST]:
@@ -476,16 +497,13 @@ def resolve_name_binding(
             # miss here is the walk's final None.)
             return _module_scope_bindings(module).get(name)
         best = None
-        for node in _scope_level_nodes(scope):
-            bind = _binding_of(node, name)
-            if bind is not None and sequential and (
-                bind.lineno > getattr(at, "lineno", bind.lineno)
+        for node in _scope_binding_index(module, scope).get(name, ()):
+            if sequential and (
+                node.lineno > getattr(at, "lineno", node.lineno)
             ):
-                bind = None
-            if bind is not None and (
-                best is None or bind.lineno > best.lineno
-            ):
-                best = bind
+                continue
+            if best is None or node.lineno > best.lineno:
+                best = node
         if isinstance(
             scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
         ):
@@ -629,6 +647,7 @@ def all_rules() -> List[Rule]:
         rules_donation,
         rules_locks,
         rules_resources,
+        rules_shapes,
         rules_sharding,
         rules_trace,
     )
@@ -642,6 +661,7 @@ def all_rules() -> List[Rule]:
         rules_donation,
         rules_sharding,
         rules_actors,
+        rules_shapes,
     ):
         rules.extend(r() for r in mod.RULES)
     return rules
@@ -695,6 +715,12 @@ class LintResult:
     files_scanned: int
     duration_s: float
     stale_baseline: List[str] = dataclasses.field(default_factory=list)
+    # Relpaths rules actually ran on. Equals every parsed file on a full
+    # scan; a --changed scan parses everything (the project model needs
+    # the whole tree) but checks only the diff closure — and baseline
+    # bookkeeping (stale detection, --write-baseline drops) must scope
+    # to THIS set, never to everything parsed.
+    checked_relpaths: set = dataclasses.field(default_factory=set)
 
 
 def _unused_suppression_findings(
@@ -735,7 +761,13 @@ def lint_paths(
     rule_ids: Optional[Sequence[str]] = None,
     baseline: Optional[dict] = None,
     root: Optional[Path] = None,
+    changed_only: Optional[Sequence[str]] = None,
 ) -> LintResult:
+    """Scan `paths`. With `changed_only` (repo-relative posix paths of
+    changed files), EVERYTHING is still parsed — the cross-module
+    symbol table and call graph must see the whole scan — but rules run
+    only on the changed files plus their reverse import dependents from
+    the project model (`ray-tpu lint --changed`)."""
     t0 = time.perf_counter()
     full_run = rules is None and not rule_ids
     rules = list(rules) if rules is not None else all_rules()
@@ -781,10 +813,21 @@ def lint_paths(
 
     from ray_tpu.tools.lint.project import ProjectInfo  # noqa: PLC0415
 
-    ProjectInfo(modules)
+    project = ProjectInfo(modules)
+    if changed_only is None:
+        checked = {m.relpath for m in modules}
+    else:
+        checked = project.reverse_import_closure(set(changed_only))
     for module in modules:
-        suppressions_by_file[module.relpath] = module.suppressions
         lines_by_file[module.relpath] = module.lines
+        # Suppressions classify by the FINDING's path, and a checked
+        # module's cross-module rule may attribute a finding to an
+        # unchecked defining module — so every parsed module's
+        # suppressions stay available, while rules (and the meta
+        # suppression findings) run only on the checked set.
+        suppressions_by_file[module.relpath] = module.suppressions
+        if module.relpath not in checked:
+            continue
         raw.extend(module.suppression_findings())
         for rule in rules:
             raw.extend(rule.check(module))
@@ -825,6 +868,10 @@ def lint_paths(
         # honored; inline self-suppression would be circular, skipped).
         orphans: List[Finding] = []
         for relpath, sups in suppressions_by_file.items():
+            if relpath not in checked:
+                # An unchecked module's suppressions matched nothing
+                # because its rules never ran, not because they rotted.
+                continue
             orphans.extend(_unused_suppression_findings(sups, relpath))
         orphans.sort(key=Finding.key)
         for f in orphans:
@@ -848,15 +895,18 @@ def lint_paths(
         active.sort(key=Finding.key)
 
     # Stale = the scan COULD have re-produced the entry (its file was
-    # scanned with its rule active) and did not. A path- or rule-scoped
-    # run must not report the rest of the baseline as stale.
-    scanned_rule_ids = {r.id for r in rules}
-    scanned_relpaths = set(lines_by_file)
+    # CHECKED with its rule active) and did not. A path-, rule- or
+    # diff-scoped run must not report the rest of the baseline as
+    # stale. The meta findings are producible too: RTL002 on every run,
+    # RTL003 only when the full registry ran.
+    scanned_rule_ids = {r.id for r in rules} | {"RTL002"}
+    if full_run:
+        scanned_rule_ids.add("RTL003")
     stale = [
         fp for fp, entry in baseline.items()
         if fp not in produced
         and entry.get("rule") in scanned_rule_ids
-        and entry.get("path") in scanned_relpaths
+        and entry.get("path") in checked
     ]
     return LintResult(
         findings=active,
@@ -866,6 +916,7 @@ def lint_paths(
         files_scanned=n_files,
         duration_s=time.perf_counter() - t0,
         stale_baseline=stale,
+        checked_relpaths=checked,
     )
 
 

@@ -313,6 +313,92 @@ class ProjectInfo:
             return qualkey(sym.module, sym.node)
         return None
 
+    # -- import graph (ray-tpu lint --changed) ------------------------------
+
+    def _import_targets(self, module: ModuleInfo) -> Set[str]:
+        """Every absolute dotted name `module` imports, raw: alias
+        targets, bare `import pkg.mod` names (the alias map stores only
+        "pkg" for those), and `from X import *` bases (which bind no
+        alias at all)."""
+        cached = module.memo.get("import_targets")
+        if cached is not None:
+            return cached
+        targets: Set[str] = set(module.aliases.values())
+        for node in module.nodes(ast.Import):
+            for a in node.names:
+                targets.add(a.name)
+        for node in module.nodes(ast.ImportFrom):
+            base = module._import_base(node)
+            if base is not None:
+                targets.add(base)
+        module.memo["import_targets"] = targets
+        return targets
+
+    def import_deps(self) -> Dict[str, Set[str]]:
+        """relpath -> relpaths of scanned modules it imports (through
+        any alias: `import x`, `from x import y [as z]`, bare dotted
+        imports, `import *`; re-export chains are NOT followed here — a
+        changed re-exporting __init__ is itself an import of its
+        sources, so the transitive closure covers them)."""
+        cached = self.memo.get("import_deps")
+        if cached is not None:
+            return cached
+        out: Dict[str, Set[str]] = {}
+        for module in self.modules:
+            deps: Set[str] = set()
+            for alias in self._import_targets(module):
+                parts = alias.split(".")
+                # Longest module prefix wins, mirroring resolve():
+                # "pkg.mod.Symbol" depends on pkg/mod.py, and plain
+                # "pkg.mod" on the module itself (or its __init__).
+                for cut in range(len(parts), 0, -1):
+                    dep = self.by_name.get(".".join(parts[:cut]))
+                    if dep is not None:
+                        deps.add(dep.relpath)
+                        break
+            deps.discard(module.relpath)
+            out[module.relpath] = deps
+        self.memo["import_deps"] = out
+        return out
+
+    def reverse_import_closure(self, relpaths) -> Set[str]:
+        """The given modules plus every scanned module that imports any
+        of them, transitively — the set a diff-scoped lint run must
+        re-check (cross-module rules can change their verdict in any
+        importer of a changed file). A changed path with NO module in
+        the scan (deleted or renamed) still seeds the closure with its
+        former importers, matched by module name against each module's
+        raw import targets — a pure deletion must re-check everything
+        that resolved symbols through the deleted file."""
+        deps = self.import_deps()
+        importers: Dict[str, Set[str]] = {}
+        for src, targets in deps.items():
+            for t in targets:
+                importers.setdefault(t, set()).add(src)
+        stack = [p for p in relpaths if p in self.by_relpath]
+        missing_names = [
+            module_name_for(p)
+            for p in relpaths
+            if p not in self.by_relpath and p.endswith(".py")
+        ]
+        if missing_names:
+            for module in self.modules:
+                targets = self._import_targets(module)
+                if any(
+                    t == name or t.startswith(name + ".")
+                    for name in missing_names
+                    for t in targets
+                ):
+                    stack.append(module.relpath)
+        out: Set[str] = set()
+        while stack:
+            p = stack.pop()
+            if p in out:
+                continue
+            out.add(p)
+            stack.extend(importers.get(p, ()))
+        return out
+
     # -- actor index --------------------------------------------------------
 
     def actor_index(self) -> "ActorIndex":

@@ -86,6 +86,12 @@ def _actor_edges(project) -> List[Edge]:
     if cached is not None:
         return cached
     index = project.actor_index()
+    if not index.classes:
+        # No actor classes anywhere in the scan: no edges, and the
+        # (expensive) project call graph need not be built at all —
+        # this keeps diff-scoped runs over actor-free modules fast.
+        project.memo["actor_edges"] = []
+        return []
     graph = project.call_graph()
     fn_index = project.function_index()
     method_owner = {}  # method qualkey -> actor class key

@@ -301,14 +301,19 @@ def jitted_bindings(module: ModuleInfo):
     return out
 
 
-def _binding_for_call(
+def binding_for_call_ex(
     module: ModuleInfo, call: ast.Call
-) -> Optional[JitBinding]:
-    """The JitBinding a call site dispatches to, when resolvable."""
+) -> Optional[Tuple[ModuleInfo, JitBinding]]:
+    """(defining module, JitBinding) for the program a call site
+    dispatches to, when resolvable. The defining module matters when a
+    `self._fn = jax.jit(...)` binding lives in a base class from
+    another file — the wrapped FunctionDef must be analyzed with THAT
+    module's import aliases (the RTL8xx interpreter does exactly that)."""
     attr, local, defs = jitted_bindings(module)
     func = call.func
     if isinstance(func, ast.Call):
-        return _binding_from_wrapper_call(module, func)
+        binding = _binding_from_wrapper_call(module, func)
+        return (module, binding) if binding is not None else None
     if (
         isinstance(func, ast.Attribute)
         and isinstance(func.value, ast.Name)
@@ -330,7 +335,7 @@ def _binding_for_call(
             cattr, _, _ = jitted_bindings(cmod)
             binding = cattr.get((id(cnode), func.attr))
             if binding is not None:
-                return binding
+                return (cmod, binding)
             project = cmod.project
             for base in cnode.bases:
                 resolved = None
@@ -354,9 +359,18 @@ def _binding_for_call(
             scope_ids.add(id(module.tree))
             for b in candidates:
                 if b.scope_id in scope_ids:
-                    return b
-        return defs.get(func.id)
+                    return (module, b)
+        binding = defs.get(func.id)
+        return (module, binding) if binding is not None else None
     return None
+
+
+def _binding_for_call(
+    module: ModuleInfo, call: ast.Call
+) -> Optional[JitBinding]:
+    """The JitBinding a call site dispatches to, when resolvable."""
+    resolved = binding_for_call_ex(module, call)
+    return resolved[1] if resolved is not None else None
 
 
 def _enclosing_stmt(module: ModuleInfo, node: ast.AST) -> ast.stmt:

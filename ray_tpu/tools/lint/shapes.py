@@ -1,0 +1,2549 @@
+"""Abstract shape/dtype/sharding interpretation for `ray-tpu lint`.
+
+The RTL8xx family (rules_shapes.py) reasons about *array geometry* where
+the earlier families reason about names: does the buffer a caller feeds a
+jitted program actually have the shape the traced body requires? Does a
+donated buffer alias any output, or does donation silently degrade to a
+copy? Does the mesh axis size divide the dim a PartitionSpec shards?
+
+This module is the engine under those rules: a small abstract
+interpreter that evaluates Python functions over an abstract array
+domain —
+
+  * **dims** are polynomials over named symbols with integer
+    coefficients (`Dim`): `128`, `B`, `nb*bs`, `k+1` are all exact
+    values; arithmetic (`+ - * //`) stays symbolic, and inexact
+    division introduces a fresh *quotient symbol* (`(s//bs)`) so that
+    two occurrences of the same expression remain provably equal;
+  * **finite sets** (`ElementOf`) model values drawn from a
+    statically-resolved bucket table — the join of the loop variable in
+    `for b in (8, 16, 32): ...` — which is what lets RTL805 compare a
+    fed width against the table that warmed the program;
+  * **arrays** (`AbstractArray`) carry a shape tuple (dims may be TOP),
+    a dtype (numpy-style promotion over the common names), and an
+    optional sharding;
+  * **TOP** is the explicit "don't know" for anything unmodeled. Every
+    propagation rule and every check degrades to TOP/silence rather
+    than guessing — unknowns can never fire a finding, so the RTL8xx
+    rules are false-positive-free *by construction* (a finding always
+    comes with two statically-proven, contradictory facts).
+
+Two facts are only ever *provably* different when their difference is a
+nonzero constant (`bucket` vs `bucket + 8`, `5` vs `3`), never merely
+"not syntactically equal" — `B` vs `C` stays silent because nothing
+rules out B == C at runtime.
+
+The interpreter walks real statements (assignments with unpacking,
+branches joined, loops run to a two-pass fixpoint, calls into
+project-resolvable functions inlined to a small depth) and models the
+common jnp/np/lax surface: constructors, reshape/transpose, matmul /
+einsum, concatenate/stack, slicing and `.at[...].set`, dynamic_slice,
+reductions, astype, where/broadcasting, plus `jax.jit` (via the RTL5xx
+binding parser, so donate/static argnums ride along), `shard_map`,
+`Mesh`/`PartitionSpec`/`NamedSharding` and `device_put` /
+`with_sharding_constraint`. Geometry contradictions (reshape size,
+matmul contraction, broadcast, concatenate) land in an error sink the
+rules attribute to the jitted call site under scrutiny.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.tools.lint.core import (
+    ModuleInfo,
+    _resolve_function,
+    call_kwargs,
+    resolve_name_binding,
+)
+
+# ---------------------------------------------------------------------------
+# the abstract domain
+# ---------------------------------------------------------------------------
+
+
+class _Top:
+    """The explicit unknown. Any operation touching TOP yields TOP, and
+    no check ever fires on it."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "TOP"
+
+
+TOP = _Top()
+
+
+class Dim:
+    """A dimension as a polynomial over named symbols: `{monomial:
+    coeff}` where a monomial is a sorted tuple of symbol names and `()`
+    is the constant term. Exact arithmetic keeps expressions like
+    `nb*bs` and `k+1` comparable; inexact ops mint composite symbols
+    (`(a//b)`) so equal expressions stay equal."""
+
+    __slots__ = ("terms",)
+
+    def __init__(self, terms: Dict[Tuple[str, ...], int]):
+        self.terms = {m: c for m, c in terms.items() if c != 0}
+
+    @staticmethod
+    def const(value: int) -> "Dim":
+        return Dim({(): int(value)})
+
+    @staticmethod
+    def symbol(name: str) -> "Dim":
+        return Dim({(name,): 1})
+
+    @property
+    def is_const(self) -> bool:
+        return all(m == () for m in self.terms)
+
+    @property
+    def const_value(self) -> Optional[int]:
+        return self.terms.get((), 0) if self.is_const else None
+
+    def key(self) -> tuple:
+        return tuple(sorted(self.terms.items()))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Dim) and self.terms == other.terms
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        if not self.terms:
+            return "0"
+        parts = []
+        for m, c in sorted(self.terms.items()):
+            name = "*".join(m) if m else ""
+            if name:
+                parts.append(name if c == 1 else f"{c}*{name}")
+            else:
+                parts.append(str(c))
+        return "+".join(parts).replace("+-", "-")
+
+    # -- arithmetic ---------------------------------------------------------
+
+    def add(self, other: "Dim") -> "Dim":
+        out = dict(self.terms)
+        for m, c in other.terms.items():
+            out[m] = out.get(m, 0) + c
+        return Dim(out)
+
+    def neg(self) -> "Dim":
+        return Dim({m: -c for m, c in self.terms.items()})
+
+    def sub(self, other: "Dim") -> "Dim":
+        return self.add(other.neg())
+
+    def mul(self, other: "Dim") -> "Dim":
+        out: Dict[Tuple[str, ...], int] = {}
+        for m1, c1 in self.terms.items():
+            for m2, c2 in other.terms.items():
+                m = tuple(sorted(m1 + m2))
+                out[m] = out.get(m, 0) + c1 * c2
+        if len(out) > 16:  # runaway products are not worth tracking
+            return Dim.symbol(f"({self!r}*{other!r})")
+        return Dim(out)
+
+    def floordiv(self, other: "Dim"):
+        """Exact division when provable, else a canonical quotient
+        symbol — floor semantics must not be simplified away."""
+        d = other.const_value
+        if d is not None:
+            if d == 0:
+                return TOP
+            if all(c % d == 0 for c in self.terms.values()):
+                return Dim({m: c // d for m, c in self.terms.items()})
+        if self == other:
+            return Dim.const(1)
+        return Dim.symbol(f"({self!r}//{other!r})")
+
+    def mod(self, other: "Dim"):
+        d = other.const_value
+        if d is not None and d != 0 and all(
+            c % d == 0 for c in self.terms.values()
+        ):
+            return Dim.const(0)
+        if self == other:
+            return Dim.const(0)
+        return Dim.symbol(f"({self!r}%{other!r})")
+
+    # -- decision procedures ------------------------------------------------
+
+    def provably_ne(self, other: "Dim") -> bool:
+        """True only when the difference is a nonzero constant — the one
+        case where inequality holds for EVERY symbol assignment."""
+        diff = self.sub(other)
+        return diff.is_const and diff.const_value != 0
+
+    def divisible_by(self, k: int) -> Optional[bool]:
+        """True/False when provable, None when unknown: all coefficients
+        divisible -> yes; only the constant term indivisible -> no."""
+        if k <= 0:
+            return None
+        non_const_ok = all(
+            c % k == 0 for m, c in self.terms.items() if m != ()
+        )
+        if not non_const_ok:
+            return None
+        return self.terms.get((), 0) % k == 0
+
+
+class ElementOf:
+    """An integer drawn from a statically-known finite set — e.g. the
+    loop variable ranging over a bucket table."""
+
+    __slots__ = ("values",)
+    MAX = 64
+
+    def __init__(self, values):
+        self.values = frozenset(int(v) for v in values)
+
+    def __eq__(self, other):
+        return isinstance(other, ElementOf) and self.values == other.values
+
+    def __hash__(self):
+        return hash(self.values)
+
+    def __repr__(self):
+        return f"ElementOf({sorted(self.values)})"
+
+    def map(self, fn):
+        out = {fn(v) for v in self.values}
+        if len(out) > self.MAX:
+            return TOP
+        if len(out) == 1:
+            return Dim.const(next(iter(out)))
+        return ElementOf(out)
+
+
+class Opaque:
+    """An unknown value with an identity: the attribute/subscript path
+    it was read from (`self.cfg.block_size`, `tokens.shape[1]`). Two
+    reads of the same path inside one root evaluation denote the same
+    value, which is what makes symbolic shape equality provable."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __repr__(self):
+        return f"Opaque({self.path})"
+
+
+@dataclasses.dataclass
+class AbstractArray:
+    """shape: tuple of Dim/ElementOf/TOP, or TOP for unknown rank."""
+
+    shape: object  # tuple | TOP
+    dtype: object  # str | TOP
+    sharding: object = None  # ShardingVal | None
+
+    @property
+    def rank(self) -> Optional[int]:
+        return len(self.shape) if isinstance(self.shape, tuple) else None
+
+    def with_(self, shape=None, dtype=None):
+        return AbstractArray(
+            shape=self.shape if shape is None else shape,
+            dtype=self.dtype if dtype is None else dtype,
+            sharding=self.sharding,
+        )
+
+
+@dataclasses.dataclass
+class AbstractMesh:
+    names: object  # tuple[str, ...] | TOP
+    sizes: object  # tuple[int, ...] | TOP
+
+    def axis_size(self, name: str) -> Optional[int]:
+        if not isinstance(self.names, tuple) or not isinstance(
+            self.sizes, tuple
+        ):
+            return None
+        try:
+            return self.sizes[self.names.index(name)]
+        except ValueError:
+            return None
+
+
+@dataclasses.dataclass
+class SpecVal:
+    """PartitionSpec: one entry per dim — a tuple of axis names (an
+    entry like `("dp", "fsdp")` shards one dim over both), None for
+    replicated, TOP for unresolvable."""
+
+    entries: Tuple[object, ...]
+
+
+@dataclasses.dataclass
+class ShardingVal:
+    mesh: object  # AbstractMesh | TOP
+    spec: object  # SpecVal | TOP
+
+
+@dataclasses.dataclass
+class JitProgram:
+    """A value bound to `jax.jit(fn, ...)`. `binding` is the RTL5xx
+    JitBinding (donate/static argnums in the caller's self-less view);
+    `module` is the module DEFINING the wrapped function."""
+
+    module: ModuleInfo
+    binding: object  # rules_donation.JitBinding
+
+
+@dataclasses.dataclass
+class ShardMapProgram:
+    module: ModuleInfo
+    fn_value: object  # FuncVal | TOP
+    mesh: object  # AbstractMesh | TOP
+    in_specs: object  # tuple of SpecVal/TOP | TOP
+    call: ast.Call
+
+
+@dataclasses.dataclass
+class FuncVal:
+    module: ModuleInfo
+    fn: ast.AST  # FunctionDef | Lambda
+
+
+@dataclasses.dataclass
+class PartialVal:
+    func: object
+    args: tuple
+    keywords: dict
+
+
+@dataclasses.dataclass
+class ModuleRef:
+    module: ModuleInfo
+
+
+@dataclasses.dataclass
+class ExternalRef:
+    """A dotted name rooted outside the project (jnp/np/lax/...)."""
+
+    dotted: str
+
+
+@dataclasses.dataclass
+class BoundMethod:
+    recv: object
+    name: str
+
+
+@dataclasses.dataclass
+class AtView:
+    arr: AbstractArray
+
+
+@dataclasses.dataclass
+class AtIndexed:
+    arr: AbstractArray
+    index_shape: object  # abstract shape of the selected region, or TOP
+
+
+@dataclasses.dataclass
+class ListRepeat:
+    """`[x] * n` — a host list whose length is an abstract dim."""
+
+    elem: object
+    length: object  # Dim | ElementOf | TOP
+
+
+@dataclasses.dataclass
+class GeometryError:
+    node: ast.AST
+    message: str
+
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+DTYPE_NAMES = {
+    "bool", "int8", "uint8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+}
+FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
+_PROMOTE_ORDER = [
+    "bool", "int8", "uint8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+]
+
+
+def dtype_of(value) -> object:
+    """Map an abstract value used in dtype position to a dtype name."""
+    if isinstance(value, str) and value in DTYPE_NAMES:
+        return value
+    if isinstance(value, ExternalRef):
+        last = value.dotted.rsplit(".", 1)[-1]
+        if last in DTYPE_NAMES:
+            return last
+        if last == "float":
+            return "float64"
+        if last == "int":
+            return "int64"
+    return TOP
+
+
+def promote(a, b):
+    if a is TOP or b is TOP:
+        return TOP
+    if a == b:
+        return a
+    if a not in _PROMOTE_ORDER or b not in _PROMOTE_ORDER:
+        return TOP
+    hi = max(a, b, key=_PROMOTE_ORDER.index)
+    lo = min(a, b, key=_PROMOTE_ORDER.index)
+    # bf16/f16 are unordered siblings: their join is f32.
+    if {hi, lo} == {"bfloat16", "float16"}:
+        return "float32"
+    return hi
+
+
+# ---------------------------------------------------------------------------
+# dim coercion / joins
+# ---------------------------------------------------------------------------
+
+
+def as_dim(value):
+    """Coerce an abstract value into a shape-dim: Dim/ElementOf pass
+    through, ints become constants, Opaques become symbols, everything
+    else is TOP."""
+    if isinstance(value, (Dim, ElementOf)):
+        return value
+    if isinstance(value, bool):
+        return TOP
+    if isinstance(value, int):
+        return Dim.const(value)
+    if isinstance(value, Opaque):
+        return Dim.symbol(value.path)
+    return TOP
+
+
+def as_shape(value) -> object:
+    """Coerce a value used as a shape argument: a tuple/list of
+    dim-ables, or a single *explicitly scalar* dim for 1-d
+    constructors. An Opaque here stays TOP — it could be a tuple at
+    runtime, and guessing rank 1 would manufacture false mismatches."""
+    if isinstance(value, (tuple, list)):
+        return tuple(as_dim(v) for v in value)
+    if isinstance(value, (int, Dim, ElementOf)) and not isinstance(
+        value, bool
+    ):
+        d = as_dim(value)
+        return TOP if d is TOP else (d,)
+    return TOP
+
+
+def dims_equal(a, b) -> Optional[bool]:
+    """True / False when provable, None when unknown."""
+    if a is TOP or b is TOP:
+        return None
+    if isinstance(a, Dim) and isinstance(b, Dim):
+        if a == b:
+            return True
+        if a.provably_ne(b):
+            return False
+        return None
+    if isinstance(a, ElementOf) and isinstance(b, Dim):
+        c = b.const_value
+        if c is not None and c not in a.values:
+            return False
+        return None
+    if isinstance(a, Dim) and isinstance(b, ElementOf):
+        return dims_equal(b, a)
+    if isinstance(a, ElementOf) and isinstance(b, ElementOf):
+        if not (a.values & b.values):
+            return False
+        return None
+    return None
+
+
+def join_dim(a, b):
+    if a is TOP or b is TOP:
+        return TOP
+    if a == b:
+        return a
+    av = a.values if isinstance(a, ElementOf) else (
+        {a.const_value} if isinstance(a, Dim) and a.is_const else None
+    )
+    bv = b.values if isinstance(b, ElementOf) else (
+        {b.const_value} if isinstance(b, Dim) and b.is_const else None
+    )
+    if av is not None and bv is not None:
+        merged = av | bv
+        if len(merged) <= ElementOf.MAX:
+            return ElementOf(merged)
+    return TOP
+
+
+def join(a, b):
+    """Join of two abstract values (if/loop merge). Conservative: equal
+    values survive, joinable families join, everything else is TOP."""
+    if a is b:
+        return a
+    if a is TOP or b is TOP:
+        return TOP
+    if isinstance(a, bool) and isinstance(b, bool):
+        return a if a == b else TOP
+    if isinstance(a, (int, Dim, ElementOf)) and isinstance(
+        b, (int, Dim, ElementOf)
+    ):
+        return join_dim(as_dim(a), as_dim(b))
+    if isinstance(a, AbstractArray) and isinstance(b, AbstractArray):
+        if isinstance(a.shape, tuple) and isinstance(b.shape, tuple) and (
+            len(a.shape) == len(b.shape)
+        ):
+            shape = tuple(
+                join_dim(x, y) for x, y in zip(a.shape, b.shape)
+            )
+        else:
+            shape = TOP
+        return AbstractArray(
+            shape=shape,
+            dtype=a.dtype if a.dtype == b.dtype else TOP,
+        )
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(join(x, y) for x, y in zip(a, b))
+    if a == b:
+        return a
+    return TOP
+
+
+def shape_fully_known(shape) -> bool:
+    return isinstance(shape, tuple) and all(
+        isinstance(d, Dim) for d in shape
+    )
+
+
+def total_size(shape):
+    out = Dim.const(1)
+    for d in shape:
+        if not isinstance(d, Dim):
+            return None
+        out = out.mul(d)
+    return out
+
+
+def flatten_leaves(value) -> Optional[List[object]]:
+    """Pytree leaves of a return value; None when the structure itself
+    is unknown (a TOP anywhere that could HIDE an array)."""
+    if isinstance(value, (tuple, list)):
+        out: List[object] = []
+        for v in value:
+            sub = flatten_leaves(v)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    if value is TOP or isinstance(value, Opaque):
+        return None
+    return [value]
+
+
+# ---------------------------------------------------------------------------
+# broadcasting
+# ---------------------------------------------------------------------------
+
+
+def broadcast_dims(a, b, sink: Optional[List[str]] = None):
+    """Broadcast two dims; a provable conflict (both known, neither
+    provably-1-compatible) appends a message to `sink`."""
+    if a is TOP or b is TOP:
+        return TOP
+    one = Dim.const(1)
+    if isinstance(a, Dim) and a == one:
+        return b
+    if isinstance(b, Dim) and b == one:
+        return a
+    eq = dims_equal(a, b)
+    if eq:
+        return a
+    if eq is False:
+        # Only a provable conflict when neither side can still be 1.
+        a_not_one = dims_equal(a, one) is False
+        b_not_one = dims_equal(b, one) is False
+        if a_not_one and b_not_one and sink is not None:
+            sink.append(f"cannot broadcast dim {a!r} with {b!r}")
+        return TOP
+    return TOP
+
+
+def broadcast_shapes(sa, sb, sink: Optional[List[str]] = None):
+    if not isinstance(sa, tuple) or not isinstance(sb, tuple):
+        return TOP
+    out = []
+    la, lb = len(sa), len(sb)
+    for i in range(max(la, lb)):
+        da = sa[la - 1 - i] if i < la else Dim.const(1)
+        db = sb[lb - 1 - i] if i < lb else Dim.const(1)
+        out.append(broadcast_dims(da, db, sink))
+    return tuple(reversed(out))
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+_EXTERNAL_ROOTS = (
+    "jax", "numpy", "jax.numpy", "jax.lax", "jax.nn", "functools",
+    "jax.sharding", "jax.experimental", "jax.experimental.mesh_utils",
+)
+
+_ELEMENTWISE_UNARY = {
+    "exp", "log", "sqrt", "tanh", "sin", "cos", "abs", "negative",
+    "relu", "gelu", "sigmoid", "softmax", "log_softmax", "square",
+    "rsqrt", "sign", "floor", "ceil", "stop_gradient", "copy",
+}
+_ELEMENTWISE_BINARY = {
+    "add", "subtract", "multiply", "divide", "true_divide", "maximum",
+    "minimum", "power", "mod", "equal", "not_equal", "greater", "less",
+    "greater_equal", "less_equal", "logical_and", "logical_or",
+}
+_REDUCTIONS = {
+    "sum", "mean", "max", "min", "prod", "all", "any", "argmax",
+    "argmin", "var", "std",
+}
+
+
+class _Return(Exception):
+    pass
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Budget(Exception):
+    pass
+
+
+class Frame:
+    __slots__ = ("module", "env", "attrs", "returns")
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.env: Dict[str, object] = {}
+        # (base path, attr) -> value, for `self.x = ...` style stores.
+        self.attrs: Dict[Tuple[str, str], object] = {}
+        self.returns: List[object] = []
+
+
+class Interp:
+    """One root evaluation. Hooks:
+
+    jit_resolver(module, call) -> Optional[(def_module, JitBinding)] —
+        maps a call node to the jit binding it dispatches to (self-attr
+        and base-chain resolution lives in rules_shapes).
+    on_jit_call(call, module, def_module, binding, args, kwargs) ->
+        abstract result (or TOP). `args is None` means the call site's
+        arguments could not be modeled (e.g. an opaque *splat).
+    on_sharding_apply(call, module, array, sharding) — device_put /
+        with_sharding_constraint sites.
+    on_shard_call(call, module, program, args) — invocation of a
+        shard_map-wrapped callable.
+    on_assign(module, node, name, value) — every name/self-attr bind
+        (RTL804 pairing harvest).
+    """
+
+    MAX_DEPTH = 5
+
+    def __init__(
+        self,
+        project,
+        jit_resolver: Optional[Callable] = None,
+        on_jit_call: Optional[Callable] = None,
+        on_sharding_apply: Optional[Callable] = None,
+        on_shard_call: Optional[Callable] = None,
+        on_assign: Optional[Callable] = None,
+        budget: int = 20000,
+    ):
+        self.project = project
+        self.jit_resolver = jit_resolver
+        self.on_jit_call = on_jit_call
+        self.on_sharding_apply = on_sharding_apply
+        self.on_shard_call = on_shard_call
+        self.on_assign = on_assign
+        self.errors: List[GeometryError] = []
+        self._budget = budget
+        self._depth = 0
+        self._global_memo: Dict[Tuple[int, str], object] = {}
+        self._opaque_counter = itertools.count()
+        # self-token path -> (module, ClassDef): lets `self.X` reads in
+        # a method seed from the class's __init__ assignments. Tokens
+        # are per-class so a root in class A calling class B's bound
+        # jit program never sees A's attributes as B's.
+        self._self_classes: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        self._class_attrs: Dict[int, object] = {}
+
+    # -- error sink ---------------------------------------------------------
+
+    def geometry_error(self, node: ast.AST, message: str) -> None:
+        self.errors.append(GeometryError(node, message))
+
+    def _flush_sink(self, node: ast.AST, sink: List[str]) -> None:
+        for msg in sink:
+            self.geometry_error(node, msg)
+
+    # -- function evaluation ------------------------------------------------
+
+    def eval_root(
+        self, module: ModuleInfo, fn: ast.AST
+    ) -> Tuple[object, Frame]:
+        """Evaluate `fn` as an analysis root: every parameter seeded as
+        an Opaque symbol. Returns (joined return value, final frame) —
+        the frame's env/attrs hold the JOINED post-body bindings, which
+        is what geometry pairing rules must look at (a value assigned
+        in only one branch joins to TOP and stays silent)."""
+        frame = Frame(module)
+        args = fn.args if not isinstance(fn, ast.Module) else None
+        if args is not None:
+            for p in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+                frame.env[p.arg] = Opaque(p.arg)
+            if args.vararg is not None:
+                frame.env[args.vararg.arg] = TOP
+            if args.kwarg is not None:
+                frame.env[args.kwarg.arg] = TOP
+            params = [p.arg for p in (*args.posonlyargs, *args.args)]
+            if params and params[0] in ("self", "cls"):
+                frame.env[params[0]] = self.self_token(module, fn)
+        self._depth += 1
+        try:
+            try:
+                self.exec_body(frame, fn.body)
+            except (_Return, _Break, _Continue):
+                pass
+        except _Budget:
+            pass
+        finally:
+            self._depth -= 1
+        out: object = TOP
+        if frame.returns:
+            out = frame.returns[0]
+            for r in frame.returns[1:]:
+                out = join(out, r)
+        return out, frame
+
+    def eval_function(
+        self,
+        module: ModuleInfo,
+        fn: ast.AST,
+        args: Sequence[object],
+        kwargs: Optional[Dict[str, object]] = None,
+        self_value: object = None,
+    ) -> object:
+        """Evaluate a FunctionDef/Lambda body with abstract arguments;
+        returns the join of its returns (TOP when nothing resolves)."""
+        if self._depth >= self.MAX_DEPTH:
+            return TOP
+        kwargs = kwargs or {}
+        frame = Frame(module)
+        params = [
+            p.arg for p in (*fn.args.posonlyargs, *fn.args.args)
+        ]
+        pos = list(args)
+        if self_value is not None and params and params[0] in (
+            "self", "cls"
+        ):
+            frame.env[params[0]] = self_value
+            params = params[1:]
+        if len(pos) > len(params) and fn.args.vararg is None:
+            return TOP  # arity mismatch: do not guess a binding
+        for name, value in zip(params, pos):
+            frame.env[name] = value
+        for name in params[len(pos):]:
+            if name in kwargs:
+                frame.env[name] = kwargs[name]
+            else:
+                frame.env[name] = Opaque(name)
+        for p in fn.args.kwonlyargs:
+            frame.env[p.arg] = kwargs.get(p.arg, Opaque(p.arg))
+        if fn.args.vararg is not None:
+            frame.env[fn.args.vararg.arg] = tuple(pos[len(params):])
+        if fn.args.kwarg is not None:
+            frame.env[fn.args.kwarg.arg] = TOP
+        self._depth += 1
+        try:
+            if isinstance(fn, ast.Lambda):
+                return self.eval_expr(frame, fn.body)
+            try:
+                self.exec_body(frame, fn.body)
+            except _Return:
+                pass
+            except (_Break, _Continue):
+                pass
+        except _Budget:
+            return TOP
+        finally:
+            self._depth -= 1
+        if not frame.returns:
+            return TOP
+        out = frame.returns[0]
+        for r in frame.returns[1:]:
+            out = join(out, r)
+        return out
+
+    def fresh_opaque(self, label: str) -> Opaque:
+        return Opaque(f"{label}#{next(self._opaque_counter)}")
+
+    # -- class-level self-attribute seeding ---------------------------------
+
+    def self_token(self, module: ModuleInfo, fn: ast.AST) -> Opaque:
+        """The `self` value for a method of a statically-known class:
+        an Opaque whose path is registered so attribute reads can seed
+        from the class's __init__. Falls back to a plain Opaque for
+        functions with no enclosing class."""
+        cls = module.parent(fn)
+        while cls is not None and not isinstance(cls, ast.ClassDef):
+            cls = module.parent(cls)
+        if cls is None:
+            return Opaque("self")
+        token = f"self@{module.relpath}:{cls.name}"
+        self._self_classes[token] = (module, cls)
+        return Opaque(token)
+
+    @staticmethod
+    def _plain_method(
+        module: ModuleInfo, cls: ast.AST, attr: str
+    ) -> Optional[ast.AST]:
+        """An undecorated instance method named `attr` on `cls` (a
+        decorated one — staticmethod, cached, remote — is opaque)."""
+        for member in cls.body:
+            if (
+                isinstance(member, ast.FunctionDef)
+                and member.name == attr
+                and not member.decorator_list
+            ):
+                return member
+        return None
+
+    @staticmethod
+    def _property_getter(
+        module: ModuleInfo, cls: ast.AST, attr: str
+    ) -> Optional[ast.AST]:
+        """The @property getter for `cls.attr`, when one exists — a
+        `self.X` read through a property is as seedable as an __init__
+        assignment (the runner's `self._pools` tuple)."""
+        for member in cls.body:
+            if not isinstance(member, ast.FunctionDef):
+                continue
+            if member.name != attr:
+                continue
+            for dec in member.decorator_list:
+                if isinstance(dec, ast.Name) and dec.id == "property":
+                    return member
+        return None
+
+    def class_self_attrs(self, module: ModuleInfo, cls: ast.AST) -> Dict:
+        """attr -> abstract value assigned to `self.attr` in the
+        class's __init__ (base classes merged first, subclass wins),
+        evaluated once per class with __init__'s parameters as Opaque
+        symbols. A cycle returns {} while in progress."""
+        state = self._class_attrs.get(id(cls), "miss")
+        if state == "busy":
+            return {}
+        if state != "miss":
+            return state
+        self._class_attrs[id(cls)] = "busy"
+        out: Dict[str, object] = {}
+        if self.project is not None:
+            for base in cls.bases:
+                sym = self.project.resolve_expr(module, base)
+                if sym is not None and isinstance(
+                    sym.node, ast.ClassDef
+                ):
+                    out.update(
+                        self.class_self_attrs(sym.module, sym.node)
+                    )
+        init = next(
+            (
+                m for m in cls.body
+                if isinstance(m, ast.FunctionDef)
+                and m.name == "__init__"
+            ),
+            None,
+        )
+        if init is not None and self._depth < self.MAX_DEPTH:
+            token = f"self@{module.relpath}:{cls.name}"
+            frame = Frame(module)
+            for p in (*init.args.posonlyargs, *init.args.args,
+                      *init.args.kwonlyargs):
+                frame.env[p.arg] = Opaque(p.arg)
+            params = [
+                p.arg for p in (*init.args.posonlyargs, *init.args.args)
+            ]
+            if params:
+                frame.env[params[0]] = Opaque(token)
+            self._depth += 1
+            try:
+                try:
+                    self.exec_body(frame, init.body)
+                except (_Return, _Break, _Continue):
+                    pass
+            except _Budget:
+                pass
+            finally:
+                self._depth -= 1
+            for (base_path, attr), value in frame.attrs.items():
+                if base_path == token:
+                    out[attr] = value
+        self._class_attrs[id(cls)] = out
+        return out
+
+    # -- statements ---------------------------------------------------------
+
+    def exec_body(self, frame: Frame, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.exec_stmt(frame, stmt)
+
+    def _tick(self) -> None:
+        self._budget -= 1
+        if self._budget <= 0:
+            raise _Budget
+
+    def exec_stmt(self, frame: Frame, stmt: ast.stmt) -> None:
+        self._tick()
+        if isinstance(stmt, ast.Return):
+            value = (
+                self.eval_expr(frame, stmt.value)
+                if stmt.value is not None
+                else None
+            )
+            frame.returns.append(value)
+            raise _Return
+        if isinstance(stmt, ast.Assign):
+            value = self.eval_expr(frame, stmt.value)
+            for target in stmt.targets:
+                self.bind(frame, target, value, stmt)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.bind(
+                    frame, stmt.target,
+                    self.eval_expr(frame, stmt.value), stmt,
+                )
+            return
+        if isinstance(stmt, ast.AugAssign):
+            # x += y: evaluate as BinOp on the current binding.
+            cur = self.eval_expr(frame, stmt.target)
+            rhs = self.eval_expr(frame, stmt.value)
+            value = self.binop(stmt, type(stmt.op), cur, rhs)
+            self.bind(frame, stmt.target, value, stmt)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.eval_expr(frame, stmt.value)
+            return
+        if isinstance(stmt, ast.If):
+            cond = self.eval_expr(frame, stmt.test)
+            if cond is True:
+                self.exec_body(frame, stmt.body)
+                return
+            if cond is False:
+                self.exec_body(frame, stmt.orelse)
+                return
+            self._exec_branches(frame, [stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exec_for(frame, stmt)
+            return
+        if isinstance(stmt, ast.While):
+            self._exec_loop_body(frame, stmt.body)
+            self.exec_body(frame, stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                value = self.eval_expr(frame, item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(frame, item.optional_vars, value, stmt)
+            self.exec_body(frame, stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            branches = [stmt.body]
+            for handler in stmt.handlers:
+                branches.append(handler.body)
+            self._exec_branches(frame, branches)
+            self.exec_body(frame, stmt.orelse)
+            self.exec_body(frame, stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Raise):
+            raise _Return  # this path produces no value
+        if isinstance(stmt, ast.Break):
+            raise _Break
+        if isinstance(stmt, ast.Continue):
+            raise _Continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            frame.env[stmt.name] = FuncVal(frame.module, stmt)
+            return
+        if isinstance(stmt, (ast.Assert, ast.Pass, ast.Import,
+                             ast.ImportFrom, ast.Global, ast.Nonlocal,
+                             ast.Delete, ast.ClassDef)):
+            return
+        # Unknown statement kinds are skipped, not guessed at.
+        return
+
+    def _exec_branches(
+        self, frame: Frame, bodies: Sequence[Sequence[ast.stmt]]
+    ) -> None:
+        """Execute alternative branches on env copies and join."""
+        base_env = dict(frame.env)
+        base_attrs = dict(frame.attrs)
+        envs: List[Tuple[Dict, Dict]] = []
+        raised = 0
+        for body in bodies:
+            frame.env = dict(base_env)
+            frame.attrs = dict(base_attrs)
+            try:
+                self.exec_body(frame, body)
+            except _Return:
+                raised += 1
+                continue
+            except (_Break, _Continue):
+                pass
+            envs.append((frame.env, frame.attrs))
+        if not envs:
+            frame.env, frame.attrs = base_env, base_attrs
+            if raised == len(bodies):
+                raise _Return
+            return
+        env, attrs = envs[0]
+        for e2, a2 in envs[1:]:
+            env = self._join_maps(env, e2)
+            attrs = self._join_maps(attrs, a2)
+        frame.env, frame.attrs = env, attrs
+
+    @staticmethod
+    def _join_maps(a: Dict, b: Dict) -> Dict:
+        out = {}
+        for k in set(a) | set(b):
+            if k in a and k in b:
+                out[k] = join(a[k], b[k])
+            else:
+                out[k] = TOP
+        return out
+
+    def _exec_for(self, frame: Frame, stmt: ast.For) -> None:
+        it = self.eval_expr(frame, stmt.iter)
+        elem: object = TOP
+        if isinstance(it, (tuple, list)) and 0 < len(it) <= 32:
+            elem = it[0]
+            for v in it[1:]:
+                elem = join(elem, v)
+        elif isinstance(it, ListRepeat):
+            elem = it.elem
+        self.bind(frame, stmt.target, elem, stmt)
+        self._exec_loop_body(frame, stmt.body)
+        self.exec_body(frame, stmt.orelse)
+
+    def _exec_loop_body(
+        self, frame: Frame, body: Sequence[ast.stmt]
+    ) -> None:
+        """Two-pass fixpoint: run the body, join with the pre-state, run
+        again so loop-carried bindings (pool = pool.at[...].set(...))
+        see their joined value."""
+        for _ in range(2):
+            pre_env = dict(frame.env)
+            pre_attrs = dict(frame.attrs)
+            try:
+                self.exec_body(frame, body)
+            except (_Break, _Continue):
+                pass
+            except _Return:
+                # A returning path inside the loop: record and continue
+                # with the pre-loop view joined in.
+                pass
+            frame.env = self._join_maps(pre_env, frame.env)
+            frame.attrs = self._join_maps(pre_attrs, frame.attrs)
+
+    # -- binding ------------------------------------------------------------
+
+    def bind(
+        self, frame: Frame, target: ast.AST, value, stmt: ast.stmt
+    ) -> None:
+        if isinstance(target, ast.Name):
+            frame.env[target.id] = value
+            if self.on_assign is not None:
+                self.on_assign(frame.module, stmt, target.id, value)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(value, (tuple, list)) and len(value) == len(
+                elts
+            ) and not any(isinstance(e, ast.Starred) for e in elts):
+                for el, v in zip(elts, value):
+                    self.bind(frame, el, v, stmt)
+            else:
+                for el in elts:
+                    if isinstance(el, ast.Starred):
+                        self.bind(frame, el.value, TOP, stmt)
+                    else:
+                        self.bind(frame, el, TOP, stmt)
+            return
+        if isinstance(target, ast.Attribute):
+            base = self.eval_expr(frame, target.value)
+            if isinstance(base, Opaque):
+                frame.attrs[(base.path, target.attr)] = value
+                if self.on_assign is not None:
+                    self.on_assign(
+                        frame.module, stmt, target.attr, value
+                    )
+            return
+        if isinstance(target, ast.Subscript):
+            self.eval_expr(frame, target.value)
+            return
+        if isinstance(target, ast.Starred):
+            self.bind(frame, target.value, TOP, stmt)
+
+    # -- expressions --------------------------------------------------------
+
+    def eval_expr(self, frame: Frame, node: ast.AST) -> object:
+        self._tick()
+        module = frame.module
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in frame.env:
+                return frame.env[node.id]
+            return self._resolve_global(module, node.id, node)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(frame, node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(frame, node)
+        if isinstance(node, ast.Tuple):
+            return self._eval_elts(frame, node.elts, tuple)
+        if isinstance(node, ast.List):
+            return self._eval_elts(frame, node.elts, list)
+        if isinstance(node, ast.BinOp):
+            left = self.eval_expr(frame, node.left)
+            right = self.eval_expr(frame, node.right)
+            return self.binop(node, type(node.op), left, right)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval_expr(frame, node.operand)
+            if isinstance(node.op, ast.USub):
+                if isinstance(v, int):
+                    return -v
+                if isinstance(v, Dim):
+                    return v.neg()
+            if isinstance(node.op, ast.Not) and isinstance(v, bool):
+                return not v
+            return TOP
+        if isinstance(node, ast.Compare):
+            return self._eval_compare(frame, node)
+        if isinstance(node, ast.BoolOp):
+            values = [self.eval_expr(frame, v) for v in node.values]
+            if all(isinstance(v, bool) for v in values):
+                if isinstance(node.op, ast.And):
+                    return all(values)
+                return any(values)
+            return TOP
+        if isinstance(node, ast.IfExp):
+            cond = self.eval_expr(frame, node.test)
+            if cond is True:
+                return self.eval_expr(frame, node.body)
+            if cond is False:
+                return self.eval_expr(frame, node.orelse)
+            return join(
+                self.eval_expr(frame, node.body),
+                self.eval_expr(frame, node.orelse),
+            )
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(frame, node)
+        if isinstance(node, ast.Lambda):
+            return FuncVal(module, node)
+        if isinstance(node, ast.Starred):
+            return self.eval_expr(frame, node.value)
+        if isinstance(node, ast.JoinedStr):
+            return TOP
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return TOP
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                if v is not None:
+                    self.eval_expr(frame, v)
+            return TOP
+        if isinstance(node, ast.NamedExpr):
+            value = self.eval_expr(frame, node.value)
+            self.bind(frame, node.target, value, node)
+            return value
+        if isinstance(node, ast.Await):
+            return self.eval_expr(frame, node.value)
+        if isinstance(node, ast.Slice):
+            return TOP
+        return TOP
+
+    def _eval_elts(self, frame, elts, ctor):
+        out = []
+        for el in elts:
+            if isinstance(el, ast.Starred):
+                v = self.eval_expr(frame, el.value)
+                if isinstance(v, (tuple, list)):
+                    out.extend(v)
+                else:
+                    return TOP
+            else:
+                out.append(self.eval_expr(frame, el))
+        return ctor(out)
+
+    # -- names / attributes -------------------------------------------------
+
+    def _resolve_global(
+        self, module: ModuleInfo, name: str, at: ast.AST
+    ) -> object:
+        alias = module.aliases.get(name)
+        if alias is not None:
+            if self.project is not None:
+                mod = self.project.by_name.get(alias)
+                if mod is not None:
+                    return ModuleRef(mod)
+                sym = self.project.resolve(alias)
+                if sym is not None:
+                    return self._symbol_value(sym, alias)
+            root = alias.split(".")[0]
+            if alias in _EXTERNAL_ROOTS or root in (
+                "jax", "numpy", "functools"
+            ):
+                return ExternalRef(alias)
+            return Opaque(alias)
+        memo_key = (id(module), name)
+        if memo_key in self._global_memo:
+            return self._global_memo[memo_key]
+        self._global_memo[memo_key] = Opaque(name)  # cycle guard
+        bind = resolve_name_binding(module, name, at)
+        value: object = Opaque(name)
+        if isinstance(bind, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            value = FuncVal(module, bind)
+        elif isinstance(bind, ast.ClassDef):
+            value = Opaque(f"{module.relpath}:{name}")
+        elif isinstance(bind, ast.Assign):
+            gframe = Frame(module)
+            value = self.eval_expr(gframe, bind.value)
+        elif isinstance(bind, ast.AnnAssign) and bind.value is not None:
+            gframe = Frame(module)
+            value = self.eval_expr(gframe, bind.value)
+        if value is TOP:
+            value = Opaque(name)
+        self._global_memo[memo_key] = value
+        return value
+
+    def _symbol_value(self, sym, dotted: str) -> object:
+        node = sym.node
+        if node is None:
+            return ModuleRef(sym.module)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return FuncVal(sym.module, node)
+        if isinstance(node, ast.Assign):
+            gframe = Frame(sym.module)
+            return self.eval_expr(gframe, node.value)
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            gframe = Frame(sym.module)
+            return self.eval_expr(gframe, node.value)
+        return Opaque(dotted)
+
+    _ARRAY_METHODS = {
+        "reshape", "astype", "transpose", "sum", "mean", "max", "min",
+        "prod", "argmax", "argmin", "squeeze", "ravel", "flatten",
+        "copy", "all", "any", "var", "std", "take", "swapaxes",
+    }
+
+    def _eval_attribute(self, frame: Frame, node: ast.Attribute):
+        base = self.eval_expr(frame, node.value)
+        attr = node.attr
+        if isinstance(base, ModuleRef):
+            mod = base.module
+            defs = (
+                self.project.top_level(mod)
+                if self.project is not None
+                else {}
+            )
+            tnode = defs.get(attr)
+            if isinstance(tnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return FuncVal(mod, tnode)
+            if isinstance(tnode, ast.Assign):
+                return self.eval_expr(Frame(mod), tnode.value)
+            if isinstance(
+                tnode, ast.AnnAssign
+            ) and tnode.value is not None:
+                return self.eval_expr(Frame(mod), tnode.value)
+            alias = mod.aliases.get(attr)
+            if alias is not None and self.project is not None:
+                sub = self.project.by_name.get(alias)
+                if sub is not None:
+                    return ModuleRef(sub)
+            return Opaque(f"{mod.relpath}:{attr}")
+        if isinstance(base, ExternalRef):
+            return ExternalRef(f"{base.dotted}.{attr}")
+        if isinstance(base, Opaque):
+            stored = frame.attrs.get((base.path, attr))
+            if stored is not None:
+                return stored
+            owner = self._self_classes.get(base.path)
+            if owner is not None:
+                seeded = self.class_self_attrs(*owner).get(attr)
+                if seeded is not None:
+                    return seeded
+                prop = self._property_getter(*owner, attr)
+                if prop is not None:
+                    return self.eval_function(
+                        owner[0], prop, [], self_value=base
+                    )
+                method = self._plain_method(*owner, attr)
+                if method is not None:
+                    # A bound method: calling it evaluates the body
+                    # with this self (quantize/astype helpers on the
+                    # pool path stay precise).
+                    return PartialVal(
+                        func=FuncVal(owner[0], method),
+                        args=(base,),
+                        keywords={},
+                    )
+            return Opaque(f"{base.path}.{attr}")
+        if isinstance(base, AbstractArray):
+            if attr == "shape":
+                return base.shape if isinstance(
+                    base.shape, tuple
+                ) else TOP
+            if attr == "dtype":
+                return base.dtype
+            if attr == "ndim":
+                return base.rank if base.rank is not None else TOP
+            if attr == "size":
+                if isinstance(base.shape, tuple):
+                    t = total_size(base.shape)
+                    return t if t is not None else TOP
+                return TOP
+            if attr == "T":
+                if isinstance(base.shape, tuple):
+                    return base.with_(shape=tuple(reversed(base.shape)))
+                return base
+            if attr == "at":
+                return AtView(base)
+            if attr in self._ARRAY_METHODS:
+                return BoundMethod(base, attr)
+            return TOP
+        if isinstance(base, AtIndexed) and attr in (
+            "set", "add", "multiply", "min", "max",
+        ):
+            return BoundMethod(base, attr)
+        if isinstance(base, (tuple, list, ListRepeat)):
+            return BoundMethod(base, attr)
+        return TOP
+
+    # -- subscripts ---------------------------------------------------------
+
+    def _eval_subscript(self, frame: Frame, node: ast.Subscript):
+        base = self.eval_expr(frame, node.value)
+        if isinstance(base, AtView):
+            shape = self._indexed_shape(frame, base.arr, node.slice)
+            return AtIndexed(base.arr, shape)
+        idx_node = node.slice
+        if isinstance(base, (tuple, list)):
+            if isinstance(idx_node, ast.Slice):
+                lo = (
+                    self.eval_expr(frame, idx_node.lower)
+                    if idx_node.lower is not None else 0
+                )
+                hi = (
+                    self.eval_expr(frame, idx_node.upper)
+                    if idx_node.upper is not None else len(base)
+                )
+                if isinstance(lo, int) and isinstance(hi, int) and (
+                    idx_node.step is None
+                ):
+                    return type(base)(base[lo:hi])
+                return TOP
+            idx = self.eval_expr(frame, idx_node)
+            if isinstance(idx, int):
+                try:
+                    return base[idx]
+                except IndexError:
+                    return TOP
+            return TOP
+        if isinstance(base, Opaque):
+            idx = self.eval_expr(frame, idx_node)
+            if isinstance(idx, int):
+                return Opaque(f"{base.path}[{idx}]")
+            return TOP
+        if isinstance(base, AbstractArray):
+            shape = self._indexed_shape(frame, base, idx_node)
+            return AbstractArray(shape=shape, dtype=base.dtype)
+        return TOP
+
+    def _indexed_shape(self, frame: Frame, arr: AbstractArray, idx_node):
+        """Resulting shape of arr[<idx>]. numpy basic indexing for int /
+        slice / None / Ellipsis items; advanced (array) indices are
+        modeled only in the single-index and leading-batch cases."""
+        if not isinstance(arr.shape, tuple):
+            return TOP
+        items = (
+            list(idx_node.elts)
+            if isinstance(idx_node, ast.Tuple)
+            else [idx_node]
+        )
+        rank = len(arr.shape)
+        # Walk left to right; bail to TOP on anything unmodeled. None
+        # adds a dim, Ellipsis absorbs the unindexed middle; everything
+        # else consumes one dim.
+        out: List[object] = []
+        pos = 0
+        adv_shapes: List[object] = []
+        ellipsis_seen = False
+        n_real = sum(
+            0 if (
+                isinstance(it, ast.Constant)
+                and (it.value is None or it.value is Ellipsis)
+            ) else 1
+            for it in items
+        )
+        if n_real > rank:
+            # Only provable over-indexing when every subscript consumes
+            # exactly one dim (a bool mask would consume several).
+            plain = all(
+                isinstance(it, (ast.Slice, ast.Constant))
+                or not isinstance(
+                    self.eval_expr(frame, it), AbstractArray
+                )
+                for it in items
+            )
+            if plain:
+                self.geometry_error(
+                    idx_node,
+                    f"index with {n_real} subscripts into a rank-{rank}"
+                    " array",
+                )
+            return TOP
+        for it in items:
+            if isinstance(it, ast.Constant) and it.value is None:
+                out.append(Dim.const(1))
+                continue
+            if isinstance(it, ast.Constant) and it.value is Ellipsis:
+                if ellipsis_seen:
+                    return TOP
+                ellipsis_seen = True
+                take = rank - (n_real - pos)
+                out.extend(arr.shape[pos:take])
+                pos = take
+                continue
+            if isinstance(it, ast.Slice):
+                dim = arr.shape[pos]
+                pos += 1
+                lo = (
+                    self.eval_expr(frame, it.lower)
+                    if it.lower is not None else None
+                )
+                hi = (
+                    self.eval_expr(frame, it.upper)
+                    if it.upper is not None else None
+                )
+                if it.step is not None:
+                    out.append(TOP)
+                elif lo is None and hi is None:
+                    out.append(dim)
+                else:
+                    lo_d = as_dim(lo) if lo is not None else Dim.const(0)
+                    hi_d = as_dim(hi) if hi is not None else dim
+                    if not (
+                        isinstance(lo_d, Dim) and isinstance(hi_d, Dim)
+                    ):
+                        out.append(TOP)
+                        continue
+                    lc, hc = lo_d.const_value, hi_d.const_value
+                    if lc is not None and lc < 0:
+                        out.append(TOP)  # negative start: unmodeled
+                        continue
+                    if hc is not None and hc < 0:
+                        # x[: -k] -> dim - k (python semantics; exact
+                        # only when k <= dim, else the size is 0 — a
+                        # symbolic dim cannot rule that out, but the
+                        # difference could never flip a provably_ne
+                        # verdict from false to true spuriously for
+                        # the in-range programs this models).
+                        if isinstance(dim, Dim):
+                            out.append(dim.add(hi_d))
+                        else:
+                            out.append(TOP)
+                        continue
+                    if (
+                        hc is not None
+                        and lc is not None
+                        and isinstance(dim, Dim)
+                        and dim.const_value is not None
+                    ):
+                        # BOTH ends concrete: python clamps. A symbolic
+                        # start must fall through to the subtraction —
+                        # treating it as 0 would fabricate a concrete
+                        # size and a provably-false mismatch.
+                        out.append(Dim.const(
+                            max(0, min(hc, dim.const_value) - lc)
+                        ))
+                        continue
+                    out.append(hi_d.sub(lo_d))
+                continue
+            value = self.eval_expr(frame, it)
+            if isinstance(value, (int, Dim, ElementOf, Opaque)):
+                pos += 1  # scalar index: consumes a dim
+                continue
+            if isinstance(value, AbstractArray):
+                if value.dtype == "bool":
+                    return TOP  # mask indexing flattens: unmodeled
+                adv_shapes.append(value.shape)
+                pos += 1
+                continue
+            return TOP
+        out.extend(arr.shape[pos:])
+        if adv_shapes:
+            # Advanced indexing: the broadcast of the index arrays
+            # replaces the consumed dims, prepended (numpy semantics for
+            # the common leading-index case this repo uses).
+            adv = adv_shapes[0]
+            for s in adv_shapes[1:]:
+                adv = broadcast_shapes(adv, s)
+            if not isinstance(adv, tuple):
+                return TOP
+            return tuple(adv) + tuple(out)
+        return tuple(out)
+
+    # -- compare ------------------------------------------------------------
+
+    def _eval_compare(self, frame: Frame, node: ast.Compare):
+        if len(node.ops) != 1:
+            return TOP
+        left = self.eval_expr(frame, node.left)
+        right = self.eval_expr(frame, node.comparators[0])
+        op = node.ops[0]
+        if isinstance(op, (ast.Is, ast.IsNot)):
+            if right is None or left is None:
+                known = left is None and right is None or (
+                    left is None
+                    and not isinstance(right, (Opaque, _Top))
+                    and right is not None
+                ) or (
+                    right is None
+                    and not isinstance(left, (Opaque, _Top))
+                    and left is not None
+                )
+                if left is None and right is None:
+                    result = True
+                elif known:
+                    result = False
+                else:
+                    return TOP
+                return result if isinstance(op, ast.Is) else not result
+            return TOP
+        if isinstance(left, (int, bool)) and isinstance(
+            right, (int, bool)
+        ):
+            try:
+                if isinstance(op, ast.Eq):
+                    return left == right
+                if isinstance(op, ast.NotEq):
+                    return left != right
+                if isinstance(op, ast.Lt):
+                    return left < right
+                if isinstance(op, ast.LtE):
+                    return left <= right
+                if isinstance(op, ast.Gt):
+                    return left > right
+                if isinstance(op, ast.GtE):
+                    return left >= right
+            except TypeError:
+                return TOP
+        return TOP
+
+    # -- binary ops ---------------------------------------------------------
+
+    def binop(self, node: ast.AST, op: type, left, right):
+        if isinstance(left, (list, ListRepeat)) or isinstance(
+            right, (list, ListRepeat)
+        ):
+            return self._list_binop(op, left, right)
+        if isinstance(left, tuple) and isinstance(right, tuple) and (
+            op is ast.Add
+        ):
+            return left + right
+        if isinstance(left, str) or isinstance(right, str):
+            return TOP
+        if isinstance(left, AbstractArray) or isinstance(
+            right, AbstractArray
+        ):
+            return self._array_binop(node, op, left, right)
+        ld, rd = as_dim(left), as_dim(right)
+        if ld is TOP or rd is TOP:
+            return TOP
+        if isinstance(ld, ElementOf) or isinstance(rd, ElementOf):
+            return self._elementof_binop(op, ld, rd)
+        if op is ast.Add:
+            return ld.add(rd)
+        if op is ast.Sub:
+            return ld.sub(rd)
+        if op is ast.Mult:
+            return ld.mul(rd)
+        if op is ast.FloorDiv:
+            return ld.floordiv(rd)
+        if op is ast.Mod:
+            return ld.mod(rd)
+        if op is ast.Pow and ld.is_const and rd.is_const:
+            try:
+                return Dim.const(ld.const_value ** rd.const_value)
+            except (OverflowError, ValueError):
+                return TOP
+        return TOP
+
+    def _elementof_binop(self, op, ld, rd):
+        if isinstance(ld, ElementOf) and isinstance(rd, Dim) and (
+            rd.is_const
+        ):
+            c = rd.const_value
+            if op is ast.Add:
+                return ld.map(lambda v: v + c)
+            if op is ast.Sub:
+                return ld.map(lambda v: v - c)
+            if op is ast.Mult:
+                return ld.map(lambda v: v * c)
+            if op is ast.FloorDiv and c != 0:
+                return ld.map(lambda v: v // c)
+            if op is ast.Mod and c != 0:
+                return ld.map(lambda v: v % c)
+        if isinstance(rd, ElementOf) and isinstance(ld, Dim) and (
+            ld.is_const
+        ):
+            c = ld.const_value
+            if op is ast.Add:
+                return rd.map(lambda v: c + v)
+            if op is ast.Sub:
+                return rd.map(lambda v: c - v)
+            if op is ast.Mult:
+                return rd.map(lambda v: c * v)
+        return TOP
+
+    def _list_binop(self, op, left, right):
+        if op is ast.Mult:
+            lst, n = (left, right) if isinstance(
+                left, (list, ListRepeat)
+            ) else (right, left)
+            nd = as_dim(n)
+            if isinstance(lst, list) and len(lst) == 1 and nd is not TOP:
+                return ListRepeat(lst[0], nd)
+            if isinstance(lst, ListRepeat) and nd is not TOP:
+                if isinstance(lst.length, Dim) and isinstance(nd, Dim):
+                    return ListRepeat(lst.elem, lst.length.mul(nd))
+            return TOP
+        if op is ast.Add:
+            if isinstance(left, list) and isinstance(right, list):
+                return left + right
+            ll = self._list_len(left)
+            rl = self._list_len(right)
+            if isinstance(ll, Dim) and isinstance(rl, Dim):
+                elem = join(self._list_elem(left), self._list_elem(right))
+                return ListRepeat(elem, ll.add(rl))
+        return TOP
+
+    @staticmethod
+    def _list_len(v):
+        if isinstance(v, list):
+            return Dim.const(len(v))
+        if isinstance(v, ListRepeat):
+            return v.length if isinstance(v.length, Dim) else TOP
+        return TOP
+
+    @staticmethod
+    def _list_elem(v):
+        if isinstance(v, ListRepeat):
+            return v.elem
+        if isinstance(v, list) and v:
+            out = v[0]
+            for x in v[1:]:
+                out = join(out, x)
+            return out
+        return TOP
+
+    def _array_binop(self, node, op, left, right):
+        if op is ast.MatMult:
+            return self._matmul(node, left, right)
+        la = left if isinstance(left, AbstractArray) else None
+        ra = right if isinstance(right, AbstractArray) else None
+        if la is not None and ra is not None:
+            sink: List[str] = []
+            shape = broadcast_shapes(la.shape, ra.shape, sink)
+            self._flush_sink(node, sink)
+            return AbstractArray(
+                shape=shape, dtype=promote(la.dtype, ra.dtype)
+            )
+        arr = la or ra
+        if arr is None:
+            return TOP
+        other = right if la is not None else left
+        if isinstance(other, (int, Dim, ElementOf, bool)):
+            # Weak python scalar: the array's dtype wins (jax semantics).
+            return arr.with_()
+        if isinstance(other, float):
+            dt = arr.dtype
+            if dt in ("int8", "int16", "int32", "int64", "bool"):
+                dt = TOP  # weak-float promotion of int arrays varies
+            return arr.with_(dtype=dt)
+        return AbstractArray(shape=arr.shape, dtype=TOP)
+
+    def _matmul(self, node, left, right):
+        if not (
+            isinstance(left, AbstractArray)
+            and isinstance(right, AbstractArray)
+        ):
+            return TOP
+        sa, sb = left.shape, right.shape
+        if not isinstance(sa, tuple) or not isinstance(sb, tuple):
+            return AbstractArray(shape=TOP, dtype=promote(
+                left.dtype, right.dtype
+            ))
+        if len(sa) < 1 or len(sb) < 1:
+            return TOP
+        ka = sa[-1]
+        kb = sb[-2] if len(sb) >= 2 else sb[-1]
+        if dims_equal(ka, kb) is False:
+            self.geometry_error(
+                node,
+                f"matmul contraction mismatch: {ka!r} (lhs last dim) vs "
+                f"{kb!r}",
+            )
+        if len(sa) == 1 and len(sb) == 1:
+            shape: object = ()
+        elif len(sb) == 1:
+            shape = sa[:-1]
+        elif len(sa) == 1:
+            shape = sb[:-2] + sb[-1:]
+        else:
+            sink: List[str] = []
+            batch = broadcast_shapes(sa[:-2], sb[:-2], sink)
+            self._flush_sink(node, sink)
+            if not isinstance(batch, tuple):
+                shape = TOP
+            else:
+                shape = batch + (sa[-2], sb[-1])
+        return AbstractArray(
+            shape=shape, dtype=promote(left.dtype, right.dtype)
+        )
+
+    # -- calls --------------------------------------------------------------
+
+    def _eval_call(self, frame: Frame, node: ast.Call):
+        module = frame.module
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in self._BUILTIN_INTRINSICS
+            and node.func.id not in frame.env
+            and node.func.id not in module.aliases
+        ):
+            args, kwargs = self._eval_call_args(frame, node)
+            return self._builtin(frame, node, node.func.id, args, kwargs)
+        func_value = self.eval_expr(frame, node.func)
+        args, kwargs = self._eval_call_args(frame, node)
+
+        # What the call TARGETS, syntactically: jit/pjit/shard_map are
+        # recognized by dotted name too, so the project's own compat
+        # shims (ray_tpu._private.jax_compat.shard_map) count.
+        dotted_last = (module.dotted_name(node.func) or "").rsplit(
+            ".", 1
+        )[-1]
+
+        # jax.jit(...) / pjit(...) construct a program value.
+        if dotted_last in ("jit", "pjit"):
+            program = self._jit_program_from_call(module, node)
+            if program is not None:
+                return program
+            if isinstance(func_value, ExternalRef):
+                return TOP
+        if dotted_last == "shard_map" and isinstance(
+            func_value, (ExternalRef, Opaque, FuncVal, _Top)
+        ):
+            return self._shard_map_from_call(
+                frame, node, args, kwargs
+            )
+
+        if isinstance(func_value, JitProgram):
+            return self._dispatch_jit(
+                node, module, func_value.module, func_value.binding,
+                args, kwargs,
+            )
+        # Fall back to the RTL5xx binding map for self-attr programs
+        # (`self._prefill_fn(...)`) — the env cannot see __init__.
+        if self.jit_resolver is not None and isinstance(
+            func_value, (Opaque, _Top)
+        ):
+            resolved = self.jit_resolver(module, node)
+            if resolved is not None:
+                def_module, binding = resolved
+                return self._dispatch_jit(
+                    node, module, def_module, binding, args, kwargs
+                )
+        if isinstance(func_value, ShardMapProgram):
+            if self.on_shard_call is not None:
+                self.on_shard_call(node, module, func_value, args)
+            return TOP
+        if isinstance(func_value, PartialVal):
+            if args is None:
+                return TOP
+            return self._call_value(
+                frame, node, func_value.func,
+                list(func_value.args) + list(args),
+                {**func_value.keywords, **(kwargs or {})},
+            )
+        if isinstance(func_value, ExternalRef):
+            return self._intrinsic(
+                frame, node, func_value.dotted, args, kwargs
+            )
+        if isinstance(func_value, FuncVal):
+            if args is None:
+                return TOP
+            return self.eval_function(
+                func_value.module, func_value.fn, args, kwargs
+            )
+        if isinstance(func_value, BoundMethod):
+            return self._method_call(frame, node, func_value, args, kwargs)
+        return TOP
+
+    def _call_value(self, frame, node, func_value, args, kwargs):
+        if isinstance(func_value, FuncVal):
+            return self.eval_function(
+                func_value.module, func_value.fn, args, kwargs
+            )
+        if isinstance(func_value, ExternalRef):
+            return self._intrinsic(
+                frame, node, func_value.dotted, args, kwargs
+            )
+        return TOP
+
+    def _eval_call_args(self, frame: Frame, node: ast.Call):
+        """Returns (args, kwargs); args is None when a *splat of an
+        unknown value makes the argument vector unmodelable."""
+        args: List[object] = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                v = self.eval_expr(frame, a.value)
+                if isinstance(v, (tuple, list)):
+                    args.extend(v)
+                else:
+                    return None, {}
+            else:
+                args.append(self.eval_expr(frame, a))
+        kwargs: Dict[str, object] = {}
+        for kw in node.keywords:
+            if kw.arg is None:
+                v = self.eval_expr(frame, kw.value)
+                if not isinstance(v, dict):
+                    return None, {}
+                continue
+            kwargs[kw.arg] = self.eval_expr(frame, kw.value)
+        return args, kwargs
+
+    def _jit_program_from_call(
+        self, module: ModuleInfo, node: ast.Call
+    ) -> Optional[JitProgram]:
+        from ray_tpu.tools.lint.rules_donation import (  # noqa: PLC0415
+            _binding_from_wrapper_call,
+        )
+
+        binding = _binding_from_wrapper_call(module, node)
+        if binding is None:
+            return None
+        return JitProgram(module=module, binding=binding)
+
+    def _shard_map_from_call(self, frame, node, args, kwargs):
+        fn_value: object = TOP
+        if args:
+            fn_value = args[0]
+        elif node.args:
+            fn_value = self.eval_expr(frame, node.args[0])
+        mesh = (kwargs or {}).get("mesh", TOP)
+        in_specs = (kwargs or {}).get("in_specs", TOP)
+        if not isinstance(mesh, AbstractMesh):
+            mesh = TOP
+        return ShardMapProgram(
+            module=frame.module,
+            fn_value=fn_value if isinstance(fn_value, FuncVal) else TOP,
+            mesh=mesh,
+            in_specs=in_specs if isinstance(in_specs, tuple) else TOP,
+            call=node,
+        )
+
+    def _dispatch_jit(
+        self, node, module, def_module, binding, args, kwargs
+    ):
+        if self.on_jit_call is not None:
+            return self.on_jit_call(
+                node, module, def_module, binding, args, kwargs
+            )
+        return TOP
+
+    def eval_jit_body(
+        self, def_module, binding, args, kwargs
+    ) -> object:
+        """Evaluate a jit-wrapped function with call-site arguments —
+        the caller (rules) brackets this with an error-sink marker."""
+        fn = binding.fn
+        if fn is None or args is None:
+            return TOP
+        params = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args)]
+        self_value = None
+        if params and params[0] in ("self", "cls") and (
+            len(args) == len(params) - 1
+            or (len(args) < len(params) - 1 and (kwargs or fn.args.defaults))
+        ):
+            self_value = self.self_token(def_module, fn)
+        return self.eval_function(
+            def_module, fn, args, kwargs, self_value=self_value
+        )
+
+    # -- intrinsics ---------------------------------------------------------
+
+    def _intrinsic(self, frame, node, dotted, args, kwargs):
+        last = dotted.rsplit(".", 1)[-1]
+        kwargs = kwargs or {}
+        if args is None:
+            return TOP
+        a0 = args[0] if args else None
+
+        if last == "partial" and args:
+            return PartialVal(
+                func=args[0], args=tuple(args[1:]), keywords=kwargs
+            )
+        if last in ("zeros", "ones", "empty", "full"):
+            shape = as_shape(a0) if a0 is not None else TOP
+            dt_arg = None
+            if last == "full":
+                dt_arg = args[2] if len(args) > 2 else kwargs.get("dtype")
+            else:
+                dt_arg = args[1] if len(args) > 1 else kwargs.get("dtype")
+            if dt_arg is not None:
+                dtype = dtype_of(dt_arg)
+            elif dotted.startswith("numpy."):
+                dtype = "float64"  # numpy's default differs from jax's
+            else:
+                dtype = "float32"
+            return AbstractArray(shape=shape, dtype=dtype)
+        if last in ("zeros_like", "ones_like", "empty_like", "full_like"):
+            if isinstance(a0, AbstractArray):
+                dt_arg = kwargs.get("dtype")
+                dtype = dtype_of(dt_arg) if dt_arg is not None else (
+                    a0.dtype
+                )
+                return AbstractArray(shape=a0.shape, dtype=dtype)
+            return TOP
+        if last in ("asarray", "array"):
+            dt_arg = args[1] if len(args) > 1 else kwargs.get("dtype")
+            dtype = dtype_of(dt_arg) if dt_arg is not None else TOP
+            if isinstance(a0, AbstractArray):
+                return a0.with_(
+                    dtype=dtype if dt_arg is not None else a0.dtype
+                )
+            if isinstance(a0, ListRepeat):
+                return AbstractArray(shape=(a0.length,), dtype=dtype)
+            if isinstance(a0, (list, tuple)):
+                if all(
+                    isinstance(v, (int, float, Dim, ElementOf, bool))
+                    for v in a0
+                ):
+                    return AbstractArray(
+                        shape=(Dim.const(len(a0)),), dtype=dtype
+                    )
+                return AbstractArray(shape=TOP, dtype=dtype)
+            if isinstance(a0, (int, Dim, ElementOf)):
+                return AbstractArray(shape=(), dtype=dtype)
+            return AbstractArray(shape=TOP, dtype=dtype)
+        if last == "arange":
+            if len(args) == 1:
+                d = as_dim(a0)
+                if d is not TOP:
+                    return AbstractArray(shape=(d,), dtype="int32")
+            return AbstractArray(shape=TOP, dtype="int32")
+        if last == "reshape" and dotted.split(".")[0] in (
+            "jax", "numpy"
+        ):
+            if isinstance(a0, AbstractArray):
+                shape_arg = args[1] if len(args) > 1 else kwargs.get(
+                    "newshape", kwargs.get("shape")
+                )
+                return self._reshape(node, a0, as_shape(shape_arg))
+            return TOP
+        if last == "transpose":
+            if isinstance(a0, AbstractArray):
+                axes = args[1] if len(args) > 1 else kwargs.get("axes")
+                return self._transpose(a0, axes)
+            return TOP
+        if last in ("concatenate", "concat"):
+            return self._concatenate(node, a0, args, kwargs)
+        if last == "stack":
+            return self._stack(node, a0, args, kwargs)
+        if last in ("matmul", "dot"):
+            if len(args) >= 2:
+                return self._matmul(node, args[0], args[1])
+            return TOP
+        if last == "einsum":
+            return self._einsum(node, args)
+        if last == "where":
+            if len(args) == 3:
+                sink: List[str] = []
+                arrs = [a for a in args if isinstance(a, AbstractArray)]
+                if not arrs:
+                    return TOP
+                shape = arrs[0].shape
+                for a in arrs[1:]:
+                    shape = broadcast_shapes(shape, a.shape, sink)
+                self._flush_sink(node, sink)
+                dtypes = [
+                    a.dtype for a in args[1:]
+                    if isinstance(a, AbstractArray)
+                ]
+                dtype = dtypes[0] if dtypes else TOP
+                for d in dtypes[1:]:
+                    dtype = promote(dtype, d)
+                return AbstractArray(shape=shape, dtype=dtype)
+            return TOP
+        if last in _REDUCTIONS:
+            if isinstance(a0, AbstractArray):
+                axis = args[1] if len(args) > 1 else kwargs.get("axis")
+                return self._reduce(a0, last, axis, kwargs)
+            return TOP
+        if last in ("expand_dims",):
+            if isinstance(a0, AbstractArray) and isinstance(
+                a0.shape, tuple
+            ):
+                axis = args[1] if len(args) > 1 else kwargs.get("axis")
+                if isinstance(axis, int):
+                    r = len(a0.shape) + 1
+                    ax = axis if axis >= 0 else axis + r
+                    if 0 <= ax <= len(a0.shape):
+                        return a0.with_(shape=(
+                            a0.shape[:ax] + (Dim.const(1),)
+                            + a0.shape[ax:]
+                        ))
+            return TOP
+        if last == "squeeze":
+            if isinstance(a0, AbstractArray):
+                return self._squeeze(a0, args[1:] or kwargs.get("axis"))
+            return TOP
+        if last == "broadcast_to":
+            if isinstance(a0, AbstractArray) and len(args) > 1:
+                target = as_shape(args[1])
+                if isinstance(target, tuple) and isinstance(
+                    a0.shape, tuple
+                ):
+                    sink: List[str] = []
+                    broadcast_shapes(a0.shape, target, sink)
+                    self._flush_sink(node, sink)
+                return AbstractArray(shape=target, dtype=a0.dtype)
+            return TOP
+        if last == "dynamic_slice":
+            if isinstance(a0, AbstractArray) and len(args) >= 3:
+                sizes = as_shape(args[2])
+                return AbstractArray(shape=sizes, dtype=a0.dtype)
+            return TOP
+        if last == "dynamic_update_slice":
+            if isinstance(a0, AbstractArray) and len(args) >= 2 and (
+                isinstance(args[1], AbstractArray)
+            ):
+                upd = args[1]
+                if isinstance(a0.shape, tuple) and isinstance(
+                    upd.shape, tuple
+                ):
+                    if len(upd.shape) != len(a0.shape):
+                        self.geometry_error(
+                            node,
+                            "dynamic_update_slice update rank "
+                            f"{len(upd.shape)} != operand rank "
+                            f"{len(a0.shape)}",
+                        )
+                return a0.with_()
+            return TOP
+        if last == "take":
+            if isinstance(a0, AbstractArray):
+                return AbstractArray(shape=TOP, dtype=a0.dtype)
+            return TOP
+        if last == "device_put":
+            arr = a0
+            sharding = args[1] if len(args) > 1 else kwargs.get(
+                "device"
+            )
+            if isinstance(sharding, ShardingVal) and (
+                self.on_sharding_apply is not None
+            ):
+                self.on_sharding_apply(node, frame.module, arr, sharding)
+            if isinstance(arr, AbstractArray):
+                if isinstance(sharding, ShardingVal):
+                    return dataclasses.replace(arr, sharding=sharding)
+                return arr
+            return TOP
+        if last == "with_sharding_constraint":
+            arr = a0
+            sharding = args[1] if len(args) > 1 else kwargs.get(
+                "shardings"
+            )
+            if isinstance(sharding, ShardingVal) and (
+                self.on_sharding_apply is not None
+            ):
+                self.on_sharding_apply(node, frame.module, arr, sharding)
+            if isinstance(arr, AbstractArray):
+                return arr
+            return TOP
+        if last == "Mesh":
+            names_val = args[1] if len(args) > 1 else kwargs.get(
+                "axis_names"
+            )
+            names: object = TOP
+            if isinstance(names_val, str):
+                names = (names_val,)
+            elif isinstance(names_val, (tuple, list)) and all(
+                isinstance(v, str) for v in names_val
+            ):
+                names = tuple(names_val)
+            sizes: object = TOP
+            if isinstance(a0, AbstractArray) and shape_fully_known(
+                a0.shape
+            ):
+                consts = [d.const_value for d in a0.shape]
+                if all(c is not None for c in consts):
+                    sizes = tuple(consts)
+            return AbstractMesh(names=names, sizes=sizes)
+        if last == "create_device_mesh":
+            shape = as_shape(a0) if a0 is not None else TOP
+            return AbstractArray(shape=shape, dtype=TOP)
+        if last in ("PartitionSpec", "P"):
+            entries: List[object] = []
+            for a in args:
+                if a is None or isinstance(a, str):
+                    entries.append((a,) if isinstance(a, str) else None)
+                elif isinstance(a, (tuple, list)) and all(
+                    isinstance(v, str) for v in a
+                ):
+                    entries.append(tuple(a))
+                else:
+                    entries.append(TOP)
+            return SpecVal(entries=tuple(entries))
+        if last == "NamedSharding":
+            mesh = a0 if isinstance(a0, AbstractMesh) else TOP
+            spec = args[1] if len(args) > 1 else kwargs.get("spec")
+            return ShardingVal(
+                mesh=mesh,
+                spec=spec if isinstance(spec, SpecVal) else TOP,
+            )
+        if last == "astype":
+            if isinstance(a0, AbstractArray) and len(args) > 1:
+                return a0.with_(dtype=dtype_of(args[1]))
+            return TOP
+        if last in _ELEMENTWISE_UNARY:
+            if isinstance(a0, AbstractArray):
+                return a0.with_()
+            return TOP
+        if last in _ELEMENTWISE_BINARY:
+            if len(args) >= 2:
+                return self._array_binop(
+                    node, ast.Add, args[0], args[1]
+                )
+            return TOP
+        if last in DTYPE_NAMES:
+            # jnp.int32(x): a 0-d cast — keep the scalar value usable in
+            # shape arithmetic.
+            if isinstance(a0, (int, Dim, ElementOf)):
+                return a0
+            if isinstance(a0, AbstractArray):
+                return a0.with_(dtype=last)
+            return TOP
+        if dotted.startswith("jax.random."):
+            return AbstractArray(shape=TOP, dtype=TOP)
+        return TOP
+
+    # -- builtins as intrinsics --------------------------------------------
+
+    _BUILTIN_INTRINSICS = {
+        "len", "min", "max", "int", "float", "range", "enumerate",
+        "zip", "sum", "abs", "sorted", "tuple", "list",
+    }
+
+    def _builtin(self, frame, node, name, args, kwargs):
+        if args is None:
+            return TOP
+        a0 = args[0] if args else None
+        if name == "len":
+            if isinstance(a0, (tuple, list)):
+                return len(a0)
+            if isinstance(a0, ListRepeat):
+                return a0.length
+            if isinstance(a0, AbstractArray) and isinstance(
+                a0.shape, tuple
+            ) and a0.shape:
+                return a0.shape[0]
+            if isinstance(a0, Opaque):
+                return Dim.symbol(f"len({a0.path})")
+            return TOP
+        if name in ("min", "max"):
+            flat = args[0] if len(args) == 1 and isinstance(
+                args[0], (tuple, list)
+            ) else args
+            if all(isinstance(v, int) for v in flat) and flat:
+                return min(flat) if name == "min" else max(flat)
+            return TOP
+        if name in ("int", "float"):
+            if isinstance(a0, (int, float, Dim, ElementOf)):
+                return a0
+            return TOP
+        if name == "abs":
+            if isinstance(a0, int):
+                return abs(a0)
+            return TOP
+        if name == "tuple":
+            if isinstance(a0, (tuple, list)):
+                return tuple(a0)
+            return TOP
+        if name == "list":
+            if isinstance(a0, (tuple, list)):
+                return list(a0)
+            return TOP
+        if name == "enumerate":
+            if isinstance(a0, (tuple, list)):
+                return tuple((i, v) for i, v in enumerate(a0))
+            return TOP
+        if name == "zip":
+            if all(isinstance(a, (tuple, list)) for a in args):
+                return tuple(zip(*args))
+            return TOP
+        if name == "sum":
+            if isinstance(a0, (tuple, list)) and all(
+                isinstance(v, (int, Dim)) for v in a0
+            ):
+                out: object = Dim.const(0)
+                for v in a0:
+                    out = out.add(as_dim(v))
+                return out
+            return TOP
+        return TOP
+
+    # -- array method calls -------------------------------------------------
+
+    def _method_call(self, frame, node, bm: BoundMethod, args, kwargs):
+        recv = bm.recv
+        if args is None:
+            return TOP
+        if isinstance(recv, AtIndexed):
+            if bm.name in ("set", "add", "multiply", "min", "max"):
+                if args and isinstance(recv.index_shape, tuple):
+                    value = args[0]
+                    if isinstance(value, AbstractArray) and isinstance(
+                        value.shape, tuple
+                    ):
+                        sink: List[str] = []
+                        broadcast_shapes(
+                            recv.index_shape, value.shape, sink
+                        )
+                        for msg in sink:
+                            self.geometry_error(
+                                node,
+                                f".at[...].{bm.name} value shape "
+                                f"{value.shape} does not fit the "
+                                f"indexed region {recv.index_shape}: "
+                                + msg,
+                            )
+                        # A provably larger update can never fit.
+                        if len(value.shape) > len(recv.index_shape):
+                            self.geometry_error(
+                                node,
+                                f".at[...].{bm.name} value rank "
+                                f"{len(value.shape)} exceeds indexed "
+                                f"region rank {len(recv.index_shape)}",
+                            )
+                return recv.arr.with_()
+            return TOP
+        if isinstance(recv, AbstractArray):
+            if bm.name == "reshape":
+                shape_arg: object
+                if len(args) == 1:
+                    shape_arg = args[0]
+                else:
+                    shape_arg = tuple(args)
+                return self._reshape(node, recv, as_shape(shape_arg))
+            if bm.name == "astype":
+                if args:
+                    return recv.with_(dtype=dtype_of(args[0]))
+                return TOP
+            if bm.name == "transpose":
+                axes = args if args else kwargs.get("axes")
+                if axes and len(axes) == 1 and isinstance(
+                    axes[0], (tuple, list)
+                ):
+                    axes = tuple(axes[0])
+                return self._transpose(recv, axes or None)
+            if bm.name == "swapaxes":
+                if len(args) == 2 and isinstance(
+                    recv.shape, tuple
+                ) and all(isinstance(a, int) for a in args):
+                    shape = list(recv.shape)
+                    i, j = args
+                    try:
+                        shape[i], shape[j] = shape[j], shape[i]
+                    except IndexError:
+                        return TOP
+                    return recv.with_(shape=tuple(shape))
+                return TOP
+            if bm.name in ("ravel", "flatten"):
+                if isinstance(recv.shape, tuple):
+                    t = total_size(recv.shape)
+                    if t is not None:
+                        return recv.with_(shape=(t,))
+                return AbstractArray(shape=TOP, dtype=recv.dtype)
+            if bm.name == "copy":
+                return recv.with_()
+            if bm.name in _REDUCTIONS:
+                axis = args[0] if args else kwargs.get("axis")
+                return self._reduce(recv, bm.name, axis, kwargs)
+            if bm.name == "take":
+                return AbstractArray(shape=TOP, dtype=recv.dtype)
+            if bm.name == "squeeze":
+                return self._squeeze(recv, args or kwargs.get("axis"))
+        return TOP
+
+    # -- shared shape ops ---------------------------------------------------
+
+    def _reshape(self, node, arr: AbstractArray, new_shape):
+        if not isinstance(new_shape, tuple):
+            return AbstractArray(shape=TOP, dtype=arr.dtype)
+        # Resolve a single -1 when everything else is known.
+        dims = list(new_shape)
+        minus_one = [
+            i for i, d in enumerate(dims)
+            if isinstance(d, Dim) and d.is_const and d.const_value == -1
+        ]
+        if minus_one:
+            if len(minus_one) > 1:
+                return AbstractArray(shape=TOP, dtype=arr.dtype)
+            if isinstance(arr.shape, tuple):
+                total = total_size(arr.shape)
+                rest = total_size(
+                    [d for i, d in enumerate(dims) if i != minus_one[0]]
+                )
+                if total is not None and rest is not None:
+                    dims[minus_one[0]] = total.floordiv(rest)
+                    if dims[minus_one[0]] is TOP:
+                        dims[minus_one[0]] = TOP
+                else:
+                    dims[minus_one[0]] = TOP
+            else:
+                dims[minus_one[0]] = TOP
+        elif isinstance(arr.shape, tuple):
+            told = total_size(arr.shape)
+            tnew = total_size(dims)
+            if told is not None and tnew is not None and (
+                told.provably_ne(tnew)
+            ):
+                self.geometry_error(
+                    node,
+                    f"reshape from {arr.shape} (size {told!r}) to "
+                    f"{tuple(dims)} (size {tnew!r}) changes the "
+                    "element count",
+                )
+        return AbstractArray(shape=tuple(dims), dtype=arr.dtype)
+
+    def _transpose(self, arr: AbstractArray, axes):
+        if not isinstance(arr.shape, tuple):
+            return arr
+        if axes is None:
+            return arr.with_(shape=tuple(reversed(arr.shape)))
+        if isinstance(axes, (tuple, list)) and all(
+            isinstance(a, int) for a in axes
+        ) and sorted(axes) == list(range(len(arr.shape))):
+            return arr.with_(
+                shape=tuple(arr.shape[a] for a in axes)
+            )
+        return AbstractArray(shape=TOP, dtype=arr.dtype)
+
+    def _reduce(self, arr: AbstractArray, name, axis, kwargs):
+        dtype = (
+            "int32" if name in ("argmax", "argmin")
+            else "bool" if name in ("all", "any")
+            else arr.dtype
+        )
+        if not isinstance(arr.shape, tuple):
+            return AbstractArray(shape=TOP, dtype=dtype)
+        keep = kwargs.get("keepdims") is True
+        if axis is None:
+            return AbstractArray(
+                shape=tuple(Dim.const(1) for _ in arr.shape)
+                if keep else (),
+                dtype=dtype,
+            )
+        axes = axis if isinstance(axis, (tuple, list)) else [axis]
+        if not all(isinstance(a, int) for a in axes):
+            return AbstractArray(shape=TOP, dtype=dtype)
+        rank = len(arr.shape)
+        norm = {a if a >= 0 else a + rank for a in axes}
+        if not all(0 <= a < rank for a in norm):
+            return AbstractArray(shape=TOP, dtype=dtype)
+        shape = tuple(
+            Dim.const(1) if i in norm and keep else d
+            for i, d in enumerate(arr.shape)
+            if keep or i not in norm
+        )
+        return AbstractArray(shape=shape, dtype=dtype)
+
+    def _squeeze(self, arr: AbstractArray, axis):
+        if not isinstance(arr.shape, tuple):
+            return arr
+        if axis in (None, (), []):
+            if all(
+                isinstance(d, Dim) and d.is_const for d in arr.shape
+            ):
+                return arr.with_(shape=tuple(
+                    d for d in arr.shape if d.const_value != 1
+                ))
+            return AbstractArray(shape=TOP, dtype=arr.dtype)
+        axes = axis if isinstance(axis, (tuple, list)) else [axis]
+        if all(isinstance(a, int) for a in axes):
+            rank = len(arr.shape)
+            norm = {a if a >= 0 else a + rank for a in axes}
+            if all(0 <= a < rank for a in norm):
+                return arr.with_(shape=tuple(
+                    d for i, d in enumerate(arr.shape) if i not in norm
+                ))
+        return AbstractArray(shape=TOP, dtype=arr.dtype)
+
+    def _concatenate(self, node, a0, args, kwargs):
+        if not isinstance(a0, (tuple, list)):
+            return TOP
+        arrs = [a for a in a0 if isinstance(a, AbstractArray)]
+        if len(arrs) != len(a0) or not arrs:
+            return TOP
+        axis = args[1] if len(args) > 1 else kwargs.get("axis", 0)
+        if not isinstance(axis, int):
+            return AbstractArray(shape=TOP, dtype=TOP)
+        shapes = [a.shape for a in arrs]
+        if not all(isinstance(s, tuple) for s in shapes):
+            return AbstractArray(shape=TOP, dtype=TOP)
+        rank = len(shapes[0])
+        if any(len(s) != rank for s in shapes):
+            self.geometry_error(
+                node, "concatenate of arrays with different ranks"
+            )
+            return AbstractArray(shape=TOP, dtype=TOP)
+        ax = axis if axis >= 0 else axis + rank
+        if not 0 <= ax < rank:
+            return AbstractArray(shape=TOP, dtype=TOP)
+        out: List[object] = []
+        for i in range(rank):
+            if i == ax:
+                acc: object = shapes[0][i]
+                for s in shapes[1:]:
+                    if isinstance(acc, Dim) and isinstance(s[i], Dim):
+                        acc = acc.add(s[i])
+                    else:
+                        acc = TOP
+                out.append(acc)
+            else:
+                d = shapes[0][i]
+                for s in shapes[1:]:
+                    if dims_equal(d, s[i]) is False:
+                        self.geometry_error(
+                            node,
+                            f"concatenate dim {i} mismatch: {d!r} vs "
+                            f"{s[i]!r} (only the concat axis may "
+                            "differ)",
+                        )
+                    d = d if dims_equal(d, s[i]) else join_dim(d, s[i])
+                out.append(d)
+        dtype = arrs[0].dtype
+        for a in arrs[1:]:
+            dtype = promote(dtype, a.dtype)
+        return AbstractArray(shape=tuple(out), dtype=dtype)
+
+    def _stack(self, node, a0, args, kwargs):
+        if not isinstance(a0, (tuple, list)):
+            return TOP
+        arrs = [a for a in a0 if isinstance(a, AbstractArray)]
+        if len(arrs) != len(a0) or not arrs:
+            return TOP
+        shapes = [a.shape for a in arrs]
+        if not all(isinstance(s, tuple) for s in shapes):
+            return AbstractArray(shape=TOP, dtype=TOP)
+        rank = len(shapes[0])
+        if any(len(s) != rank for s in shapes):
+            self.geometry_error(
+                node, "stack of arrays with different ranks"
+            )
+            return AbstractArray(shape=TOP, dtype=TOP)
+        for i in range(rank):
+            for s in shapes[1:]:
+                if dims_equal(shapes[0][i], s[i]) is False:
+                    self.geometry_error(
+                        node,
+                        f"stack dim {i} mismatch: {shapes[0][i]!r} vs "
+                        f"{s[i]!r}",
+                    )
+        axis = args[1] if len(args) > 1 else kwargs.get("axis", 0)
+        if not isinstance(axis, int):
+            return AbstractArray(shape=TOP, dtype=TOP)
+        ax = axis if axis >= 0 else axis + rank + 1
+        if not 0 <= ax <= rank:
+            return AbstractArray(shape=TOP, dtype=TOP)
+        base = list(shapes[0])
+        base.insert(ax, Dim.const(len(arrs)))
+        dtype = arrs[0].dtype
+        for a in arrs[1:]:
+            dtype = promote(dtype, a.dtype)
+        return AbstractArray(shape=tuple(base), dtype=dtype)
+
+    def _einsum(self, node, args):
+        if not args or not isinstance(args[0], str):
+            return TOP
+        eq = args[0].replace(" ", "")
+        operands = args[1:]
+        if "..." in eq or "->" not in eq:
+            return TOP
+        lhs, rhs = eq.split("->")
+        in_specs = lhs.split(",")
+        if len(in_specs) != len(operands):
+            return TOP
+        sizes: Dict[str, object] = {}
+        for spec, op in zip(in_specs, operands):
+            if not isinstance(op, AbstractArray):
+                return TOP
+            if not isinstance(op.shape, tuple):
+                continue
+            if len(spec) != len(op.shape):
+                self.geometry_error(
+                    node,
+                    f"einsum operand spec '{spec}' has {len(spec)} "
+                    f"indices but the operand is rank {len(op.shape)}",
+                )
+                return AbstractArray(shape=TOP, dtype=TOP)
+            for letter, dim in zip(spec, op.shape):
+                prev = sizes.get(letter)
+                if prev is None:
+                    sizes[letter] = dim
+                elif dims_equal(prev, dim) is False:
+                    self.geometry_error(
+                        node,
+                        f"einsum index '{letter}' has conflicting "
+                        f"sizes {prev!r} and {dim!r}",
+                    )
+        out_shape = tuple(sizes.get(letter, TOP) for letter in rhs)
+        dtype: object = TOP
+        arrs = [
+            op for op in operands if isinstance(op, AbstractArray)
+        ]
+        if arrs:
+            dtype = arrs[0].dtype
+            for a in arrs[1:]:
+                dtype = promote(dtype, a.dtype)
+        return AbstractArray(shape=out_shape, dtype=dtype)

@@ -1129,11 +1129,13 @@ def test_cli_json_shape(tmp_path, capsys, monkeypatch):
     rc = lint_main([str(pkg), "--json"])
     report = json.loads(capsys.readouterr().out)
     assert rc == 1
-    # Schema version 2: the project-level pass added the schema field so
-    # external consumers can gate on report shape.
-    assert report["version"] == 2
-    assert report["schema"] == "ray-tpu-lint-report/2"
+    # Schema version 3: the diff-scoped scan added files_checked (new
+    # keys never appear under an old version number, so external
+    # consumers can gate on report shape).
+    assert report["version"] == 3
+    assert report["schema"] == "ray-tpu-lint-report/3"
     assert report["files_scanned"] == 1
+    assert report["files_checked"] == 1
     assert set(report["counts"]) == {
         "active", "baselined", "suppressed", "parse_errors",
         "stale_baseline", "untriaged_baseline",
@@ -1358,10 +1360,11 @@ def test_repo_is_lint_clean():
     reason — and the scan, INCLUDING the cross-module project pass the
     RTL5xx/6xx/7xx families ride on, fits the CI budget (<10s; `make
     lint` runs the same gate outside pytest)."""
-    # The gate runs the full registry: donation/sharding/actor families
-    # must be in it, or a tree full of use-after-donates reads as clean.
+    # The gate runs the full registry: donation/sharding/actor/shape
+    # families must be in it, or a tree full of use-after-donates (or
+    # drifted bucket tables) reads as clean.
     families = {r.id[:4] for r in all_rules()}
-    assert {"RTL5", "RTL6", "RTL7"} <= families
+    assert {"RTL5", "RTL6", "RTL7", "RTL8"} <= families
     baseline = baseline_mod.load_baseline(
         REPO_ROOT / baseline_mod.BASELINE_FILENAME
     )
@@ -2128,6 +2131,641 @@ def test_cross_module_finding_suppressable_in_defining_module():
 
 
 # ---------------------------------------------------------------------------
+# Family 8: abstract shape/dtype/sharding interpretation (RTL801-805)
+# ---------------------------------------------------------------------------
+
+
+def test_shape_mismatch_with_cross_module_config_constants():
+    """RTL801 seeds call-site shapes from statically-resolved config
+    constants ACROSS modules (the existing constant-resolver path), so
+    a bucket/head-dim mismatch between caller and traced body is caught
+    even when the numbers live in a config module."""
+    findings = lint_files(
+        {
+            "cfg.py": "BLOCK = 8\nHEADS = 4\n",
+            "eng.py": """
+                import jax
+                import jax.numpy as jnp
+                import cfg
+
+                def step(pool, new):
+                    return pool.reshape((cfg.BLOCK, cfg.HEADS))
+
+                def run():
+                    f = jax.jit(step)
+                    x = jnp.zeros((cfg.BLOCK, cfg.HEADS + 1))
+                    return f(x, None)
+            """,
+        }
+    )
+    hits = [f for f in findings if f.rule == "RTL801"]
+    assert len(hits) == 1
+    assert hits[0].path == "eng.py"
+    assert "reshape" in hits[0].message
+
+
+def test_shape_mismatch_symbolic_dims_stay_silent():
+    """`B` vs `C` is NOT a provable mismatch (nothing rules out B == C
+    at runtime): symbolic-but-different dims must stay silent — the
+    no-false-positives-by-construction contract."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, w):
+            return x @ w
+
+        def run(b, c):
+            f = jax.jit(step)
+            return f(jnp.zeros((4, b)), jnp.zeros((c, 16)))
+    """
+    assert "RTL801" not in rules_of(lint(src))
+
+
+def test_shape_mismatch_unknown_arg_stays_silent():
+    """TOP case: an argument whose shape comes from an unresolvable
+    helper is unknown — no rule in the family may fire on it."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from somewhere import load_buffer
+
+        def step(x, w):
+            return x @ w
+
+        def run():
+            f = jax.jit(step)
+            return f(load_buffer(), jnp.zeros((4, 16)))
+    """
+    assert rules_of(lint(src)) == []
+
+
+def test_shape_mismatch_symbolic_slice_start_stays_silent():
+    """Regression: a slice with a SYMBOLIC start and concrete stop
+    (`x[k:5]`) must not be modeled as size 5 — with k == 1 at runtime
+    the reshape below is perfectly valid, and one false positive fails
+    the whole gate."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, k):
+            return x[k:5].reshape(4)
+
+        def run(k):
+            f = jax.jit(step)
+            return f(jnp.zeros((8,)), k)
+    """
+    assert "RTL801" not in rules_of(lint(src))
+
+
+def test_shape_mismatch_symbolic_affine_fires():
+    """Affine arithmetic over ONE symbol is decidable: `n` rows vs
+    `n + 1` rows differ by a nonzero constant whatever n is."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x, y):
+            return jnp.concatenate([x, y], axis=1)
+
+        def run(n):
+            f = jax.jit(step)
+            return f(jnp.zeros((n, 4)), jnp.zeros((n + 1, 4)))
+    """
+    assert "RTL801" in rules_of(lint(src))
+
+
+def test_donation_mismatch_unknown_output_stays_silent():
+    """TOP case for RTL802: when any output's geometry is unknown, the
+    donated buffer might alias it — silence."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from somewhere import mystery
+
+        def step(buf, x):
+            return mystery(buf + x)
+
+        def run():
+            f = jax.jit(step, donate_argnums=(0,))
+            return f(jnp.zeros((8, 4), jnp.float32),
+                     jnp.zeros((8, 4), jnp.float32))
+    """
+    assert "RTL802" not in rules_of(lint(src))
+
+
+def test_donation_through_self_attr_program_symbolic_pools():
+    """The runner idiom: pools donated through a self-attr jit binding
+    and returned through the step — symbolic shapes flow end to end and
+    the donation provably aliases (clean); an astype on the way out
+    provably breaks it (fires)."""
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        class Runner:
+            def __init__(self, layers, blocks, bs, heads, dim):
+                shape = (layers, blocks, bs, heads, dim)
+                self.pool = jnp.zeros(shape, jnp.float32)
+                self._fn = jax.jit(self._step, donate_argnums=(0,))
+
+            def _step(self, pool, new):
+                return pool.at[0].set(new), new
+
+            def run(self, new):
+                pool, out = self._fn(self.pool, new)
+                self.pool = pool
+                return out
+    """
+    assert "RTL802" not in rules_of(lint(clean))
+    bad = """
+        import jax
+        import jax.numpy as jnp
+
+        class Runner:
+            def __init__(self, layers, blocks, bs, heads, dim):
+                shape = (layers, blocks, bs, heads, dim)
+                self.pool = jnp.zeros(shape, jnp.float32)
+                self._fn = jax.jit(self._step, donate_argnums=(0,))
+
+            def _step(self, pool, new):
+                return pool.astype(jnp.bfloat16)
+
+            def run(self, new):
+                return self._fn(self.pool, new)
+    """
+    assert "RTL802" in rules_of(lint(bad))
+
+
+def test_sharding_divisibility_symbolic_odd_dim_fires():
+    """Symbolic divisibility is decidable for the constant remainder:
+    `2*b + 1` is odd whatever b is, so a dp axis of size 2 can never
+    divide it."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def place(b):
+            mesh = Mesh(
+                mesh_utils.create_device_mesh((2, 4)), ("dp", "tp")
+            )
+            x = jnp.zeros((2 * b + 1, 4))
+            return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    """
+    assert "RTL803" in rules_of(lint(src))
+
+
+def test_sharding_unknown_mesh_stays_silent():
+    """TOP case for RTL803: a mesh handed in as a parameter has unknown
+    axis sizes — silence, exactly like RTL601's unknown-mesh rule."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(mesh):
+            x = jnp.zeros((9, 4))
+            return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    """
+    assert rules_of(lint(src)) == []
+
+
+def test_shard_map_in_specs_divisibility_checked():
+    """shard_map call-site args are checked against in_specs + the mesh
+    resolved through the compat shim import (the repo's own spelling)."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+
+        def body(x):
+            return x
+
+        def run():
+            mesh = Mesh(mesh_utils.create_device_mesh((4,)), ("dp",))
+            f = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                          out_specs=P("dp"))
+            return f(jnp.zeros((10, 3)))
+    """
+    assert "RTL803" in rules_of(lint(src))
+
+
+def test_paired_pool_scale_dtype_and_write_coverage():
+    """RTL804's two forms: an int dtype scale pool fires; a pool write
+    with no paired scale write fires (the CoW copy_block hazard); a
+    None-guarded scale write is the sanctioned pattern and stays clean."""
+    bad_dtype = """
+        import jax.numpy as jnp
+
+        def build(n, bs, h, d):
+            k_cache = jnp.zeros((2, n, bs, h, d), jnp.int8)
+            k_scale = jnp.zeros((2, n, bs, h), jnp.int32)
+            return k_cache, k_scale
+    """
+    assert "RTL804" in rules_of(lint(bad_dtype))
+    bad_copy = """
+        def copy_block(k_cache, k_scale, src, dst):
+            k_cache = k_cache.at[:, dst].set(k_cache[:, src])
+            return k_cache, k_scale
+    """
+    assert "RTL804" in rules_of(lint(bad_copy))
+    guarded = """
+        def copy_block(k_cache, k_scale, src, dst):
+            k_cache = k_cache.at[:, dst].set(k_cache[:, src])
+            if k_scale is not None:
+                k_scale = k_scale.at[:, dst].set(k_scale[:, src])
+            return k_cache, k_scale
+    """
+    assert "RTL804" not in rules_of(lint(guarded))
+
+
+def test_paired_pool_unknown_geometry_stays_silent():
+    """TOP case for RTL804: pools built from an opaque helper have
+    unknown dtype/shape — silence. A branch-joined scale (None on one
+    arm) is TOP too."""
+    src = """
+        import jax.numpy as jnp
+        from somewhere import pool_shape
+
+        def build(quantized):
+            k_cache = jnp.zeros(pool_shape(), jnp.int8)
+            if quantized:
+                k_scale = jnp.zeros(pool_shape())
+            else:
+                k_scale = None
+            return k_cache, k_scale
+    """
+    assert "RTL804" not in rules_of(lint(src))
+
+
+def test_bucket_drift_between_two_tables_fires():
+    """Two call sites of one program driven by two INCOMPARABLE bucket
+    tables: whichever one warmup used, the other demands widths it
+    never compiled — provable drift."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        WARM = (8, 16, 24)
+        LIVE = (8, 16, 32)
+
+        def step(t):
+            return t
+
+        def run(n):
+            f = jax.jit(step)
+            for b in WARM:
+                f(jnp.zeros((1, b), jnp.int32))
+            for b in LIVE:
+                f(jnp.zeros((1, b), jnp.int32))
+    """
+    assert "RTL805" in rules_of(lint(src))
+    # A strict SUBSET is legal (live uses fewer buckets than warmed).
+    subset = src.replace("LIVE = (8, 16, 32)", "LIVE = (8, 16)")
+    assert "RTL805" not in rules_of(lint(subset))
+
+
+def test_bucket_coverage_unknown_width_stays_silent():
+    """TOP case for RTL805: an unknown width (or an opaque whole shape)
+    is never a provable cold compile."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        BUCKETS = (8, 16)
+
+        def step(t):
+            return t
+
+        def run(n, shape):
+            f = jax.jit(step)
+            for b in BUCKETS:
+                f(jnp.zeros((1, b), jnp.int32))
+            f(jnp.zeros(shape, jnp.int32))
+            f(jnp.zeros((1, n), jnp.int32))
+    """
+    assert rules_of(lint(src)) == []
+
+
+def test_bucket_lookup_helper_resolves_to_table_membership():
+    """A `bucket_for`-style helper (first table entry >= n) abstractly
+    returns element-of-table, so padded live-path widths count as
+    covered — and a cross-module literal outside the table fires in the
+    module that feeds it."""
+    findings = lint_files(
+        {
+            "cfg.py": """
+                BUCKETS = (8, 16, 32)
+
+                def bucket_for(n):
+                    for b in BUCKETS:
+                        if b >= n:
+                            return b
+                    raise ValueError(n)
+            """,
+            "run.py": """
+                import jax
+                import jax.numpy as jnp
+                from cfg import BUCKETS, bucket_for
+
+                def step(t):
+                    return t
+
+                def serve(n):
+                    f = jax.jit(step)
+                    for b in BUCKETS:
+                        f(jnp.zeros((1, b), jnp.int32))
+                    f(jnp.zeros((1, bucket_for(n)), jnp.int32))
+                    f(jnp.zeros((1, 24), jnp.int32))
+            """,
+        }
+    )
+    hits = [f for f in findings if f.rule == "RTL805"]
+    assert len(hits) == 1
+    assert hits[0].path == "run.py"
+    assert "24" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# --changed: diff-scoped scans
+# ---------------------------------------------------------------------------
+
+
+def test_changed_only_scopes_rules_to_reverse_import_closure(tmp_path):
+    """lint_paths(changed_only=...) parses everything but runs rules
+    only on the changed files plus their importers: an unchanged,
+    unrelated module's finding must NOT appear; an importer of the
+    changed module IS re-checked."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("VALUE = 3\n")
+    (pkg / "uses.py").write_text(
+        "import time\n\nfrom pkg.base import VALUE\n\n\n"
+        "def wait(t):\n"
+        "    deadline = time.time() + t\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n"
+    )
+    (pkg / "unrelated.py").write_text(
+        "def fire(h):\n    h.ping.remote()\n"
+    )
+    result = lint_paths(
+        [pkg], root=tmp_path, changed_only=["pkg/base.py"]
+    )
+    # Closure: base.py itself + its importer uses.py — not unrelated.py.
+    assert result.checked_relpaths == {"pkg/base.py", "pkg/uses.py"}
+    assert {f.rule for f in result.findings} == {"RTL302"}
+    assert result.files_scanned == 4  # everything still parsed
+
+    # An empty diff checks nothing and is clean.
+    result = lint_paths([pkg], root=tmp_path, changed_only=[])
+    assert result.checked_relpaths == set()
+    assert result.findings == []
+
+
+def test_changed_cli_flag_against_real_git(tmp_path, capsys, monkeypatch):
+    """End to end: `ray-tpu lint --changed` diffs against git HEAD —
+    a committed-clean tree reports nothing; touching one file (and
+    adding an untracked one) scopes the scan to the diff closure."""
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+             "-c", "user.name=t", *argv],
+            check=True, capture_output=True,
+        )
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("VALUE = 3\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    monkeypatch.chdir(tmp_path)
+
+    assert lint_main([str(pkg), "--changed", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["files_checked"] == 0
+    assert report["files_scanned"] == 2
+
+    # Tracked modification + an untracked file both land in the diff.
+    (pkg / "mod.py").write_text(
+        "import time\n\n\ndef wait(t):\n"
+        "    deadline = time.time() + t\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n"
+    )
+    (pkg / "fresh.py").write_text(
+        "def fire(h):\n    h.ping.remote()\n"
+    )
+    rc = lint_main([str(pkg), "--changed", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["files_checked"] == 2
+    assert {f["rule"] for f in report["findings"]} == {
+        "RTL302", "RTL401",
+    }
+    # Outside a work tree (git errors) the flag is a usage error, not
+    # a crash — simulated, since tmp_path itself IS a work tree here.
+    from ray_tpu.tools.lint import cli as cli_mod
+
+    monkeypatch.setattr(
+        cli_mod, "_git_changed_files", lambda root: None
+    )
+    assert lint_main([str(pkg), "--changed"]) == 2
+    capsys.readouterr()
+
+
+def test_changed_relativizes_to_lint_root_in_monorepo(
+    tmp_path, capsys, monkeypatch
+):
+    """Regression: the lint root (pyproject.toml) can be a SUBDIRECTORY
+    of the git toplevel. `git diff --name-only` prints toplevel-relative
+    paths, which match no module relpath — without --relative a
+    monorepo `lint --changed` silently checked zero files and exited 0
+    over real findings."""
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+             "-c", "user.name=t", *argv],
+            check=True, capture_output=True,
+        )
+
+    sub = tmp_path / "service"
+    pkg = sub / "pkg"
+    pkg.mkdir(parents=True)
+    (sub / "pyproject.toml").write_text("[project]\nname='x'\n")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("VALUE = 3\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (pkg / "mod.py").write_text(
+        "import time\n\n\ndef wait(t):\n"
+        "    deadline = time.time() + t\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n"
+    )
+    monkeypatch.chdir(sub)
+    rc = lint_main([str(pkg), "--changed", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["files_checked"] == 1
+    assert {f["rule"] for f in report["findings"]} == {"RTL302"}
+
+
+def test_changed_closure_includes_bare_dotted_importers(tmp_path):
+    """`import pkg.base` (no `as`) must register a dependency on
+    pkg/base.py, not just pkg/__init__.py, or the importer escapes the
+    --changed closure. Same for `from pkg.base import *`, which binds
+    no alias at all. And deleting a module entirely must still seed the
+    closure with its former importers — a pure deletion re-checks
+    everything that resolved symbols through the deleted file."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("VALUE = 3\n")
+    (pkg / "uses.py").write_text(
+        "import pkg.base\n\nX = pkg.base.VALUE\n"
+    )
+    (pkg / "star.py").write_text("from pkg.base import *\n")
+    result = lint_paths(
+        [pkg], root=tmp_path, changed_only=["pkg/base.py"]
+    )
+    assert "pkg/uses.py" in result.checked_relpaths
+    assert "pkg/star.py" in result.checked_relpaths
+    # Deleted module: the path has no ModuleInfo, but importers of its
+    # module name (here via `import pkg.gone`) are still re-checked.
+    (pkg / "needs_gone.py").write_text(
+        "import pkg.gone\n\nY = pkg.gone.VALUE\n"
+    )
+    result = lint_paths(
+        [pkg], root=tmp_path, changed_only=["pkg/gone.py"]
+    )
+    assert "pkg/needs_gone.py" in result.checked_relpaths
+
+
+def test_changed_run_still_sees_cross_module_bucket_tables(tmp_path):
+    """The RTL805 site sweep stays PROJECT-wide on diff-scoped runs: a
+    checked module's literal width must still be judged against the
+    bucket table that warms the program from an UNCHECKED module —
+    otherwise a triaged entry would read as stale and --write-baseline
+    would drop it."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "warm.py").write_text(textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        BUCKETS = (8, 16, 32)
+
+        def step(t):
+            return t
+
+        PROG = jax.jit(step)
+
+        def warmup():
+            for b in BUCKETS:
+                PROG(jnp.zeros((1, b), jnp.int32))
+        """
+    ))
+    (pkg / "live.py").write_text(textwrap.dedent(
+        """
+        import jax.numpy as jnp
+        from pkg.warm import PROG
+
+        def serve():
+            PROG(jnp.zeros((1, 24), jnp.int32))
+        """
+    ))
+    full = lint_paths([pkg], root=tmp_path)
+    assert "RTL805" in {f.rule for f in full.findings}
+    scoped = lint_paths(
+        [pkg], root=tmp_path, changed_only=["pkg/live.py"]
+    )
+    assert "pkg/warm.py" not in scoped.checked_relpaths
+    assert "RTL805" in {f.rule for f in scoped.findings}
+
+
+def test_write_baseline_changed_scope_preserves_unchecked_entries(
+    tmp_path, capsys, monkeypatch
+):
+    """Regression: --write-baseline used to scope stale-dropping by
+    scan PATHS, so a diff-scoped run (file parsed but not checked)
+    would have treated every unchecked file's triaged entries as stale
+    and deleted them. The write must scope to the CHECKED set — the
+    files whose rules actually ran."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(
+        "import time\n\n\ndef wait(t):\n"
+        "    deadline = time.time() + t\n"
+        "    while time.time() < deadline:\n"
+        "        pass\n"
+    )
+    (pkg / "b.py").write_text("def fire(h):\n    h.ping.remote()\n")
+    monkeypatch.chdir(tmp_path)
+    bl_path = tmp_path / baseline_mod.BASELINE_FILENAME
+    assert lint_main([str(pkg), "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert len(data["findings"]) == 2
+    for e in data["findings"]:
+        e["reason"] = "triaged: fixture"
+    bl_path.write_text(json.dumps(data))
+
+    # Diff-scoped rewrite touching only a.py: b.py was parsed but NOT
+    # checked — its triaged entry (and reason) must survive verbatim.
+    from ray_tpu.tools.lint import cli as cli_mod
+
+    monkeypatch.setattr(
+        cli_mod, "_git_changed_files", lambda root: {"pkg/a.py"}
+    )
+    assert lint_main([str(pkg), "--changed", "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert {e["rule"] for e in data["findings"]} == {"RTL302", "RTL401"}
+    assert all(
+        e["reason"] == "triaged: fixture" for e in data["findings"]
+    )
+    # The checked file's entry DOES drop once its finding is fixed.
+    (pkg / "a.py").write_text("VALUE = 3\n")
+    assert lint_main([str(pkg), "--changed", "--write-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(bl_path.read_text())
+    assert {e["rule"] for e in data["findings"]} == {"RTL401"}
+    assert lint_main([str(pkg)]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
 # CLI: --sarif, --explain
 # ---------------------------------------------------------------------------
 
@@ -2145,6 +2783,10 @@ def test_cli_sarif_shape(tmp_path, capsys, monkeypatch):
     assert driver["name"] == "ray-tpu-lint"
     ids = {r["id"] for r in driver["rules"]}
     assert {"RTL501", "RTL601", "RTL701"} <= ids
+    # The RTL8xx catalog rides the same driver (make lint-sarif).
+    assert {
+        "RTL801", "RTL802", "RTL803", "RTL804", "RTL805",
+    } <= ids
     results = run["results"]
     assert {r["ruleId"] for r in results} == {"RTL302", "RTL401"}
     for r in results:

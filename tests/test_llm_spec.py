@@ -169,6 +169,53 @@ def test_build_proposer_dispatch():
     assert dr.name == "draft"
 
 
+class _StubReq:
+    def __init__(self, rid):
+        self.request_id = rid
+        self.max_new_tokens = 16
+        self.eos_id = None
+
+
+class _StubSeq:
+    def __init__(self, ids, rid="r1"):
+        self.prefill_ids = list(ids)
+        self.request = _StubReq(rid)
+        self.generated = []
+
+
+def test_draft_proposer_first_contact_chain_crossing_block_boundary():
+    """Regression (RTL8xx triage): the draft mirror table is sized for
+    the prompt PLUS the proposal chain (_reserve), but the first-contact
+    prefill program's block vector holds exactly bucket_for(n) //
+    block_size ids. Feeding the whole mirror table made numpy reject
+    the scatter ("could not broadcast"), _catch_up swallowed the
+    ValueError as a bucket overflow, and speculation was silently
+    disabled for every prompt whose chain crossed a block boundary —
+    including all block-aligned prompts. The proposer must return a
+    full k-chain for both geometries."""
+    k = 4
+    # Block-aligned prompt: n == block_size, chain spills into block 2.
+    dr = build_proposer(spec_cfg("draft"), seed=0)
+    props = dr.propose([_StubSeq(range(1, 9))], k)
+    assert len(props[0]) == k, (
+        "draft proposer produced no chain for a block-aligned prompt"
+    )
+    # Mid-block prompt whose chain still crosses the boundary (n=7,
+    # chain writes reach position 9).
+    dr2 = build_proposer(spec_cfg("draft"), seed=0)
+    props2 = dr2.propose([_StubSeq(range(1, 8))], k)
+    assert len(props2[0]) == k
+    # Steady state stays intact: commit the first proposal + a bonus
+    # token and re-propose through the partial-prefill path.
+    seq = _StubSeq(range(1, 9))
+    dr3 = build_proposer(spec_cfg("draft"), seed=0)
+    first = dr3.propose([seq], k)[0]
+    seq.prefill_ids.extend([first[0], 42])
+    seq.generated.extend([first[0], 42])
+    again = dr3.propose([seq], k)
+    assert len(again[0]) == k
+
+
 # ---------------- scheduler: reserve + rollback ----------------
 
 
