@@ -662,6 +662,141 @@ def bench_serving_speculative():
                     )
 
 
+def bench_serving_chunked_prefill():
+    """Chunked prefill: the latency-shaping claim. One long prompt lands
+    on an engine with a steady pool of decoding requests; with chunking
+    OFF its whole prefill monopolizes one engine step, so every in-flight
+    decode stalls behind it (a decode-TPOT p99 spike the size of the full
+    prefill); with a per-step token budget the prompt streams in as
+    block-aligned chunks interleaved with the decode batch, so decode
+    inter-token latency stays flat and only TTFT of the long prompt
+    stretches. Outputs are asserted token-identical both ways — chunking
+    is a pure latency-shaping knob.
+
+    The p99 RATIO is asserted on CPU too (a chunk costs a bounded
+    fraction of the full prefill on any backend); the absolute TPOT
+    numbers are CPU-labeled and the production speedup claim is TPU's,
+    like the PR 7/9 rows. The budget invariant — no engine step feeds
+    more prompt tokens than configured — is asserted from the flight
+    recorder's step records."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig, LLMEngine
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(
+        vocab_size=512, num_layers=2, num_heads=4, embed_dim=128,
+        max_seq_len=512, dtype=jnp.float32, attention_impl="reference",
+    )
+    rng = np.random.RandomState(0)
+    pool_prompts = [
+        list(map(int, rng.randint(0, 512, size=12))) for _ in range(7)
+    ]
+    long_prompt = list(map(int, rng.randint(0, 512, size=448)))
+    pool_new, long_new = 48, 8
+    budget = 64
+
+    def run(budget_setting):
+        ecfg = EngineConfig(
+            block_size=16, num_blocks=96, max_decode_slots=8,
+            max_blocks_per_seq=32,
+            max_prefill_tokens_per_step=budget_setting,
+        )
+        engine = LLMEngine(cfg, ecfg, seed=0)
+        # Warm every program this scenario dispatches (both the chunked
+        # and the monolithic shapes), then drop the cached blocks so the
+        # measured run prefills cold.
+        engine.generate(
+            [list(map(int, rng.randint(0, 512, size=448)))] + pool_prompts,
+            max_new_tokens=2,
+        )
+        engine.allocator.reset_prefix_cache()
+
+        pool_tokens = [[] for _ in pool_prompts]
+        pool_stamps = [[] for _ in pool_prompts]
+        long_tokens = []
+        marks = {}
+
+        def pool_cb(i):
+            def cb(tok):
+                pool_tokens[i].append(tok)
+                pool_stamps[i].append(time.perf_counter())
+            return cb
+
+        def long_cb(tok):
+            if not long_tokens:
+                marks["first"] = time.perf_counter()
+            long_tokens.append(tok)
+
+        for i, p in enumerate(pool_prompts):
+            engine.add_request(p, max_new_tokens=pool_new,
+                               on_token=pool_cb(i))
+        # Let the pool reach steady-state decode before the long prompt.
+        while min(len(t) for t in pool_tokens) < 4:
+            engine.step()
+        marks["submit"] = time.perf_counter()
+        engine.add_request(long_prompt, max_new_tokens=long_new,
+                           on_token=long_cb)
+        while engine.has_work():
+            engine.step()
+        # Decode inter-token gaps of the pool AFTER the long prompt
+        # arrived — the latency the chunking knob is shaping. The last
+        # pre-submission stamp anchors each request's first gap: with
+        # chunking off the whole monolithic-prefill stall lands exactly
+        # there (between the last token before the long prompt and the
+        # first token after), and dropping it would hide the spike the
+        # benchmark exists to measure.
+        gaps = []
+        for stamps in pool_stamps:
+            idx = next(
+                (i for i, s in enumerate(stamps) if s >= marks["submit"]),
+                len(stamps),
+            )
+            window = stamps[max(idx - 1, 0) :]
+            gaps.extend(b - a for a, b in zip(window, window[1:]))
+        gaps.sort()
+        records = engine.flight_recorder.snapshot()["steps"]
+        if budget_setting:
+            assert all(r["tokens_in"] <= budget_setting for r in records), (
+                "an engine step exceeded the prefill token budget"
+            )
+        return {
+            "outputs": (pool_tokens, long_tokens),
+            "tpot_p50": gaps[len(gaps) // 2],
+            "tpot_p99": gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))],
+            "ttft": marks["first"] - marks["submit"],
+        }
+
+    off = run(0)
+    on = run(budget)
+    assert on["outputs"] == off["outputs"], (
+        "chunked prefill changed greedy outputs"
+    )
+    on_tpu = jax.devices()[0].platform == "tpu"
+    tag = "" if on_tpu else "_cpu"
+    report(f"serving_chunked_decode_tpot_p50_off{tag}",
+           1e3 * off["tpot_p50"], unit="ms")
+    report(f"serving_chunked_decode_tpot_p50_on{tag}",
+           1e3 * on["tpot_p50"], unit="ms")
+    report(f"serving_chunked_decode_tpot_p99_off{tag}",
+           1e3 * off["tpot_p99"], unit="ms")
+    report(f"serving_chunked_decode_tpot_p99_on{tag}",
+           1e3 * on["tpot_p99"], unit="ms")
+    report(f"serving_chunked_long_ttft_off{tag}", 1e3 * off["ttft"],
+           unit="ms")
+    report(f"serving_chunked_long_ttft_on{tag}", 1e3 * on["ttft"],
+           unit="ms")
+    report("serving_chunked_tpot_p99_ratio_on_vs_off",
+           on["tpot_p99"] / off["tpot_p99"], unit="x")
+    # Backend-independent claim: the worst decode stall shrinks, because
+    # no single step carries more than a budget-sized slice of the long prefill.
+    assert on["tpot_p99"] < off["tpot_p99"], (
+        f"chunking did not flatten decode TPOT p99: "
+        f"{on['tpot_p99']:.4f}s vs {off['tpot_p99']:.4f}s"
+    )
+
+
 def bench_serving_prefix_cache():
     """Automatic prefix caching on a prefix-heavy workload: every request
     shares a 256-token system prompt and appends a distinct 16-token user
@@ -951,6 +1086,7 @@ ALL = [
     ("serving_decode", bench_serving_decode),
     ("serving_decode_attn_impl", bench_serving_decode_attn_impl),
     ("serving_speculative", bench_serving_speculative),
+    ("serving_chunked_prefill", bench_serving_chunked_prefill),
     ("serving_prefix_cache", bench_serving_prefix_cache),
     ("serving_failover", bench_serving_failover),
     ("serving_observability", bench_serving_observability),

@@ -31,6 +31,19 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = ()
     # How many queued prompts may be prefilled in a single engine step.
     max_prefills_per_step: int = 1
+    # Chunked prefill: per-step budget of prompt tokens fed through the
+    # prefill programs. Long prompts are split into block-aligned chunks
+    # fed through the (already bucketed) partial-prefill programs, one
+    # chunk interleaved alongside the decode batch per engine iteration —
+    # so a long prompt streams in over several steps instead of
+    # monopolizing one, and decode time-per-output-token stays flat.
+    # Greedy outputs are token-identical with the budget set or unset.
+    #   -1   ("auto", the default): a block-aligned budget of roughly a
+    #        quarter of max_model_len (never below one block).
+    #   0 / None: chunking off — every prompt prefills in one dispatch,
+    #        exactly the pre-chunking behavior.
+    #   N > 0: explicit budget; must be a multiple of block_size.
+    max_prefill_tokens_per_step: Optional[int] = -1
     # Default generation bound when a request does not specify one.
     default_max_new_tokens: int = 32
     # Automatic prefix caching: full KV blocks are content-addressed
@@ -143,6 +156,20 @@ class EngineConfig:
             raise ValueError("dead_letter_capacity must be >= 1")
         if self.flight_recorder_capacity < 1:
             raise ValueError("flight_recorder_capacity must be >= 1")
+        budget = self.max_prefill_tokens_per_step
+        if budget is not None and budget > 0:
+            if budget % self.block_size:
+                raise ValueError(
+                    f"max_prefill_tokens_per_step {budget} is not a "
+                    f"multiple of block_size {self.block_size} — chunks "
+                    "must be block-aligned so non-final chunks fill whole "
+                    "blocks (prefix-cache publication and CoW depend on it)"
+                )
+        elif budget is not None and budget not in (0, -1):
+            raise ValueError(
+                "max_prefill_tokens_per_step must be -1 (auto), 0/None "
+                f"(off), or a positive multiple of block_size; got {budget}"
+            )
         if self.attn_impl not in ("auto", "pallas", "reference"):
             raise ValueError(
                 "attn_impl must be one of ('auto', 'pallas', 'reference'), "
@@ -223,6 +250,35 @@ class EngineConfig:
                     f"prefill bucket {b} exceeds max_model_len "
                     f"{self.max_model_len}"
                 )
+
+    @property
+    def prefill_token_budget(self) -> Optional[int]:
+        """The resolved per-step prefill token budget: None when chunking
+        is off (0/None), the explicit value when set, or — for -1 (auto) —
+        a block-aligned quarter of max_model_len, never below one block."""
+        v = self.max_prefill_tokens_per_step
+        if not v:  # 0 or None: chunking off
+            return None
+        if v == -1:
+            quarter = (self.max_model_len // 4) // self.block_size
+            return max(1, quarter) * self.block_size
+        return v
+
+    def chunk_widths(self) -> Tuple[int, ...]:
+        """The prefill buckets the chunked path can dispatch: every chunk
+        feeds at most prefill_token_budget tokens, so only buckets up to
+        bucket_for(budget) are reachable — warmup compiles exactly this
+        set (larger full-prefill programs can never run under a budget),
+        and lint RTL805 judges the table against the bucket table. With
+        chunking off this is the whole bucket table."""
+        budget = self.prefill_token_budget
+        if budget is None:
+            return self.buckets()
+        # A budget at or above the largest bucket can't restrict anything:
+        # admission already bounds every prefill to the largest bucket, so
+        # the whole table stays reachable.
+        cap = self.bucket_for(min(budget, self.buckets()[-1]))
+        return tuple(b for b in self.buckets() if b <= cap)
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets():

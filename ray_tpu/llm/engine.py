@@ -1,7 +1,10 @@
 """LLM inference engine: continuous batching over the paged KV cache.
 
 `LLMEngine` is the single-threaded core — one `step()` admits prefills,
-runs one iteration-level decode, streams tokens, and retires finished
+feeds each in-flight prompt its next block-aligned chunk under the
+per-step token budget (EngineConfig.max_prefill_tokens_per_step — long
+prompts stream in over several steps instead of monopolizing one), runs
+one iteration-level decode, streams tokens, and retires finished
 sequences. `LLMServer` wraps it for actor use: a background step loop, a
 blocking `generate`, and a `generate_stream` generator that pairs with
 `.options(num_returns="streaming")` on the actor handle.
@@ -147,6 +150,14 @@ class LLMEngine:
             "Requests failed in isolation after poisoning an engine step",
             tag_keys=("engine",),
         )
+        self._prefill_backlog = get_or_create(
+            Gauge,
+            "llm_engine_prefill_backlog_tokens",
+            "Prompt tokens admitted or queued but not yet fed through a "
+            "prefill program (chunked prefill drains this at "
+            "max_prefill_tokens_per_step per engine step)",
+            tag_keys=("engine",),
+        )
         self._spec_proposed = get_or_create(
             Counter,
             "llm_engine_spec_proposed_tokens",
@@ -200,10 +211,12 @@ class LLMEngine:
         self._h_step = get_or_create(
             Histogram,
             "llm_engine_step_seconds",
-            "One engine phase dispatch (prefill per sequence, decode or "
-            "speculative verify per batched step)",
+            "One engine phase dispatch (prefill per chunk per sequence, "
+            "decode or speculative verify per batched step); chunk=cont "
+            "marks a mid-prompt prefill chunk, chunk=final the dispatch "
+            "that completes a prompt (n/a for decode/verify)",
             boundaries=STEP_SECONDS_BOUNDARIES,
-            tag_keys=("engine", "phase", "attn_impl"),
+            tag_keys=("engine", "phase", "attn_impl", "chunk"),
         )
         # Which paged-attention implementation the runner resolved (pallas
         # fused kernel vs XLA reference): tagged onto the step histograms
@@ -214,7 +227,10 @@ class LLMEngine:
         # prefill runs model.apply with no paged caches — the knob cannot
         # affect it — so its series is tagged "n/a" rather than letting
         # unrelated latency differences read as kernel effects; only the
-        # partial-prefill and decode programs dispatch on attn_impl.
+        # partial-prefill and decode programs dispatch on attn_impl. The
+        # chunk tag splits prefill dispatches into mid-prompt chunks
+        # ("cont") vs the dispatch that completes a prompt ("final", which
+        # is also every unchunked prefill); decode/verify never chunk.
         self._step_tags = {
             phase: {
                 **self._metric_tags,
@@ -222,9 +238,19 @@ class LLMEngine:
                 "attn_impl": (
                     "n/a" if phase == "prefill" else self._attn_impl
                 ),
+                "chunk": (
+                    "n/a" if phase in ("decode", "verify") else "final"
+                ),
             }
             for phase in ("prefill", "partial_prefill", "decode", "verify")
         }
+        self._chunk_step_tags = {
+            phase: {**self._step_tags[phase], "chunk": "cont"}
+            for phase in ("prefill", "partial_prefill")
+        }
+        # Resolved once: None = chunking off (whole prompts in one
+        # dispatch), else the per-step prompt-token budget.
+        self._prefill_budget = self.engine_config.prefill_token_budget
         # Observability plane (EngineConfig.instrument): per-request phase
         # spans + the per-step flight-recorder ring. The recorder object
         # always exists (step FAILURES are recorded regardless), but
@@ -251,6 +277,8 @@ class LLMEngine:
         self._decode_tokens = 0
         self._decode_slot_steps = 0
         self._prefill_tokens = 0
+        self._prefill_chunk_dispatches = 0  # prefill program dispatches
+        self._chunked_prefill_requests = 0  # prompts that took > 1 chunk
         self._cache_hit_tokens = 0
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
@@ -402,8 +430,13 @@ class LLMEngine:
     # ---------------- stepping ----------------
 
     def step(self) -> dict:
-        """One engine iteration: admit prefills, decode every running
-        sequence one token, emit tokens, retire finished sequences."""
+        """One engine iteration: admit prefills, feed each in-flight
+        prompt its next chunk under the per-step token budget, decode
+        every decode-ready sequence one token, emit tokens, retire
+        finished sequences. A sequence mid-chunk stays `prefilling` — it
+        never enters the decode batch, so a chunk failure (or a step
+        retry) simply re-plans from its committed num_cached; no requeue
+        is needed to keep the running set consistent."""
         ecfg = self.engine_config
         preempted_before = self.scheduler.num_preemptions
         step_hit_tokens = 0
@@ -416,22 +449,14 @@ class LLMEngine:
         t_step = time.time() if instrument else 0.0
         t_step_p = time.perf_counter() if instrument else 0.0
 
-        admitted = self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
+        self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
+        # Mixed-step dispatch: this step's chunk plan spans newly admitted
+        # prompts AND prompts already mid-prefill from earlier steps,
+        # oldest first, capped by the token budget (None = whole prompts,
+        # the pre-chunking behavior).
+        plans = self.scheduler.schedule_prefill_chunks(self._prefill_budget)
         prefill_info: List[dict] = []
-        try:
-            step_hit_tokens += self._run_prefills(admitted, prefill_info)
-        except BaseException:
-            # A failed prefill must not leave admitted-but-never-prefilled
-            # sequences in the running set (they would decode from K/V that
-            # was never computed): requeue them recompute-style. The culprit
-            # itself is requeued too — the caller either fails it
-            # (fail_request pulls it from waiting) or retries the step,
-            # which re-admits and re-prefills it. Reversed so the chain of
-            # appendleft()s lands them back in arrival order (FIFO fairness).
-            for seq in reversed(admitted):
-                if seq.is_running and seq.num_cached < len(seq.prefill_ids):
-                    self.scheduler.preempt(seq)
-            raise
+        step_hit_tokens += self._run_prefill_chunks(plans, prefill_info)
 
         decoding = self.scheduler.schedule_decode()
         spec_info: Optional[dict] = None
@@ -478,11 +503,13 @@ class LLMEngine:
         self._evictable_blocks.set(
             self.allocator.num_evictable, tags=self._metric_tags
         )
+        backlog = self.scheduler.prefill_backlog_tokens()
+        self._prefill_backlog.set(backlog, tags=self._metric_tags)
         if instrument:
             decode_label = "verify" if spec_info is not None else "decode"
             phase = "+".join(
                 p
-                for p, on in (("prefill", admitted), (decode_label, decoding))
+                for p, on in (("prefill", plans), (decode_label, decoding))
                 if on
             ) or "idle"
             record = {
@@ -490,10 +517,15 @@ class LLMEngine:
                 "phase": phase,
                 "attn_impl": self._attn_impl,
                 "batch_size": len(decoding),
-                "num_prefills": len(admitted),
+                "num_prefills": len(plans),
                 "prefills": prefill_info,
+                # Acceptance invariant: with chunking on, tokens_in (the
+                # prompt tokens actually fed this step) never exceeds
+                # prefill_budget — asserted from these records in tests.
                 "tokens_in": sum(p["tokens"] for p in prefill_info),
-                "tokens_out": len(admitted)
+                "prefill_budget": self._prefill_budget,
+                "prefill_backlog_tokens": backlog,
+                "tokens_out": sum(1 for p in prefill_info if p["final"])
                 + (
                     spec_info["emitted"]
                     if spec_info is not None
@@ -512,7 +544,7 @@ class LLMEngine:
                 record["speculation"] = spec_info
             self.flight_recorder.record_step(record)
         return {
-            "num_prefilled": len(admitted),
+            "num_prefilled": len(plans),
             "num_decoding": len(decoding),
             "occupancy": occupancy,
             "cache_utilization": self.allocator.utilization(),
@@ -520,6 +552,7 @@ class LLMEngine:
             "preempted": preempted,
             "cache_hit_tokens": step_hit_tokens,
             "evictable_blocks": self.allocator.num_evictable,
+            "prefill_backlog_tokens": backlog,
         }
 
     def _run_decode(self, decoding: List[Sequence]) -> None:
@@ -690,27 +723,44 @@ class LLMEngine:
             "emitted": emitted,
         }
 
-    def _run_prefills(
-        self, admitted: List[Sequence], info_out: Optional[List[dict]] = None
+    def _run_prefill_chunks(
+        self,
+        plans: List[tuple],
+        info_out: Optional[List[dict]] = None,
     ) -> int:
-        """Run the prefill for each just-admitted sequence; returns the
-        prompt tokens served from the prefix cache this step. With
-        instrumentation, `info_out` collects one record per prefill for the
+        """Run this step's prefill chunk plan ((sequence, token count)
+        pairs from Scheduler.schedule_prefill_chunks); returns the prompt
+        tokens served from the prefix cache this step. Each chunk commits
+        independently (num_cached advances only after its program
+        returns), so a failure mid-plan leaves every sequence — including
+        the culprit — consistent: a retry re-plans from committed state,
+        a dead-letter releases all of the culprit's blocks via the normal
+        abort path. Only the FINAL chunk of a prompt produces a token;
+        continuation chunks just stream K/V into the cache. With
+        instrumentation, `info_out` collects one record per chunk for the
         flight recorder."""
         instrument = self._instrument
         hit_tokens = 0
-        for seq in admitted:
+        for seq, take in plans:
             # Per-sequence section: an exception below is attributable to
             # this request (LLMServer._loop fails only it and keeps going).
             rid = seq.request.request_id
             self._current_rid = rid
-            maybe_fail("llm.prefill", detail=rid)
-            offset = seq.num_cached  # tokens the admission matched in-cache
+            first_chunk = seq.num_chunks == 0
+            final = take >= seq.prefill_len - seq.num_cached
+            if first_chunk:
+                maybe_fail("llm.prefill", detail=rid)
+            maybe_fail("engine.prefill_chunk", detail=rid)
+            offset = seq.num_cached  # cache-matched prefix + prior chunks
             rt = queue_wait = None
             if instrument:
                 t0 = time.time()
                 rt = self._req_traces.get(rid)
-                if rt is not None:
+                if rt is not None and rt.queue_start is not None:
+                    # The queue ends when the request's FIRST chunk starts
+                    # computing (one wait per admission; a preempt-resume
+                    # reopens the clock and its first resumed chunk closes
+                    # it again).
                     queue_wait = rt.on_admitted(t0)
             was_cow = seq.pending_copy is not None
             if was_cow:
@@ -725,35 +775,69 @@ class LLMEngine:
                 self.runner.copy_block(src, dst)
                 self.allocator.free([src])  # drop admission's copy-source ref
                 seq.pending_copy = None
-            n_suffix = len(seq.prefill_ids) - offset
+            chunk_ids = seq.prefill_ids[offset : offset + take]
             if offset > 0:
-                first = self.runner.prefill_suffix(
-                    seq.prefill_ids[offset:], seq.block_table, offset
+                tok = self.runner.prefill_suffix(
+                    chunk_ids, seq.block_table, offset
                 )
-                hit_tokens += offset
+                if first_chunk:
+                    hit_tokens += offset
             else:
-                first = self.runner.prefill(seq.prefill_ids, seq.block_table)
-            self._prefill_tokens += len(seq.prefill_ids)
-            seq.num_cached = len(seq.prefill_ids)
+                # First chunk from a cold cache: the full-prefill program
+                # for this chunk's bucket. Slice the table — the sequence
+                # owns blocks for its WHOLE prompt, but this program's
+                # block vector is sized for the chunk's bucket.
+                tok = self.runner.prefill(
+                    chunk_ids,
+                    seq.block_table[
+                        : blocks_for_tokens(
+                            take, self.engine_config.block_size
+                        )
+                    ],
+                )
+            self._prefill_tokens += take
+            self._prefill_chunk_dispatches += 1
+            seq.num_cached = offset + take
+            seq.num_chunks += 1
+            if final and seq.num_chunks > 1:
+                self._chunked_prefill_requests += 1
+            # Publish every block this chunk filled: a concurrent request
+            # with the same prompt can share the prefix before the whole
+            # prompt even finishes prefilling.
             self.scheduler.note_filled_blocks(seq)
-            seq.generated.append(first)
+            if final:
+                seq.generated.append(tok)
             if instrument:
                 t1 = time.time()
                 kind = "cow" if was_cow else ("partial" if offset else "full")
                 phase = "partial_prefill" if offset else "prefill"
-                bucket = self.engine_config.bucket_for(max(n_suffix, 1))
+                bucket = self.engine_config.bucket_for(max(take, 1))
                 # ray-tpu: lint-ignore[RTL302] t0/t1 double as span
                 # timestamps (wall-clock identity across actors); the
                 # histogram delta rides on the same pair
-                self._h_step.observe(t1 - t0, tags=self._step_tags[phase])
-                self._h_queue.observe(queue_wait or 0.0, tags=self._metric_tags)
+                self._h_step.observe(
+                    t1 - t0,
+                    tags=(
+                        self._step_tags[phase]
+                        if final
+                        else self._chunk_step_tags[phase]
+                    ),
+                )
+                if queue_wait is not None:
+                    self._h_queue.observe(
+                        queue_wait, tags=self._metric_tags
+                    )
                 if rt is not None:
                     first_admission = rt.first_token_s is None
                     rt.on_prefilled(
-                        t0, t1, kind, bucket, n_suffix, offset,
+                        t0, t1, kind, bucket, take, offset,
                         len(seq.generated),
+                        chunk=seq.num_chunks - 1, final=final,
                     )
-                    if first_admission:
+                    if final and first_admission:
+                        # TTFT observes exactly once per request: at the
+                        # final chunk of its FIRST admission (chunked or
+                        # not), when the first token actually exists.
                         self._h_ttft.observe(
                             t1 - rt.submit_s, tags=self._metric_tags
                         )
@@ -763,12 +847,15 @@ class LLMEngine:
                             "request_id": rid,
                             "kind": kind,
                             "bucket": bucket,
-                            "tokens": n_suffix,
+                            "tokens": take,
                             "cached_tokens": offset,
+                            "chunk": seq.num_chunks - 1,
+                            "final": final,
                         }
                     )
-            self._emit(seq)
-            self._maybe_finish(seq)
+            if final:
+                self._emit(seq)
+                self._maybe_finish(seq)
         self._current_rid = None
         return hit_tokens
 
@@ -871,6 +958,12 @@ class LLMEngine:
             "queue_depth": len(self.scheduler.waiting),
             "num_running": len(self.scheduler.running),
             "prefill_tokens": self._prefill_tokens,
+            "prefill_token_budget": self._prefill_budget,
+            "prefill_backlog_tokens": (
+                self.scheduler.prefill_backlog_tokens()
+            ),
+            "prefill_chunk_dispatches": self._prefill_chunk_dispatches,
+            "chunked_prefill_requests": self._chunked_prefill_requests,
             "prefix_cache_hit_tokens": self._cache_hit_tokens,
             "prefix_cache_hit_rate": (
                 self._cache_hit_tokens / max(self._prefill_tokens, 1)
@@ -975,7 +1068,14 @@ class LLMServer:
     def _warmup(self) -> None:
         ecfg = self._engine.engine_config
         buckets = ecfg.buckets()
-        for bucket in buckets:
+        # With a chunked-prefill budget, prompts never feed more than
+        # bucket_for(budget) tokens per dispatch, so larger bucket
+        # programs are UNREACHABLE — warming them would waste init time
+        # and charge compile blame for programs live traffic can't run.
+        # chunk_widths() is exactly the reachable set (== buckets() when
+        # chunking is off).
+        widths = ecfg.chunk_widths()
+        for bucket in widths:
             # Prompt length landing in this bucket, shaped so the whole
             # request passes admission (lifetime within the largest
             # bucket and max_model_len). 2 tokens when room allows: the
@@ -1014,7 +1114,7 @@ class LLMServer:
             # fully-cached path (CoW + smallest suffix bucket).
             alloc = self._engine.allocator
             bs = ecfg.block_size
-            for bucket in buckets + (0,):
+            for bucket in widths + (0,):
                 alloc.reset_prefix_cache()
                 n = min(bs + bucket, ecfg.max_model_len - 1, buckets[-1])
                 t0 = time.monotonic()
@@ -1032,6 +1132,25 @@ class LLMServer:
                     time.monotonic() - t0,
                 )
             alloc.reset_prefix_cache()
+        if ecfg.prefill_token_budget is not None:
+            # Chunked prefill dispatches BOTH prefill program families at
+            # every reachable width: the full program for a cold first
+            # chunk, the partial program for every continuation chunk
+            # (and, with prefix caching off, the generate rounds above
+            # never compiled the partial family at all). Compile each
+            # (width × program) pair directly against the null block —
+            # writes land in block 0, no allocator state is touched, and
+            # already-compiled pairs are cache hits — so no chunk can
+            # cold-compile under live traffic.
+            runner = self._engine.runner
+            null_table = [0] * ecfg.max_blocks_per_seq
+            for w in widths:
+                t0 = time.monotonic()
+                runner.prefill([0] * w, [0])
+                runner.prefill_suffix([0] * w, null_table, 0)
+                self._engine.flight_recorder.record_compile(
+                    "chunk_prefill", w, time.monotonic() - t0
+                )
 
     def _warmup_verify(self, spec) -> None:
         """Compile every k-token verify bucket program plus whatever the

@@ -118,11 +118,16 @@ class RequestTrace:
     def on_prefilled(
         self, start_s: float, now: float, kind: str, bucket: int,
         n_tokens: int, cached_tokens: int, n_generated: int,
+        chunk: int = 0, final: bool = True,
     ) -> None:
         """One prefill program ran for this request (kind: full | partial |
-        cow). Opens a decode stretch: tokens generated from here to the
-        next preempt/finish belong to it (the prefill's own first token is
-        attributed to the prefill span, not the stretch)."""
+        cow; `chunk` indexes the dispatch within the current admission
+        under chunked prefill). Only the FINAL chunk produces a token, so
+        only it sets first-token time and opens a decode stretch: tokens
+        generated from here to the next preempt/finish belong to it (the
+        prefill's own first token is attributed to the prefill span, not
+        the stretch). Continuation chunks just record their span — TTFT
+        keeps exactly one observation per request either way."""
         self.prefills += 1
         self._emit(
             "llm.prefill",
@@ -133,8 +138,12 @@ class RequestTrace:
                 "bucket": bucket,
                 "tokens": n_tokens,
                 "cached_tokens": cached_tokens,
+                "chunk": chunk,
+                "final": final,
             },
         )
+        if not final:
+            return
         if self.first_token_s is None:
             self.first_token_s = now
         self.stretch_start = now

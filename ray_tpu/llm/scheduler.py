@@ -15,6 +15,14 @@ allocated and recomputed (the engine's partial-prefill program). Because a
 preempted victim's full blocks stay cached-but-evictable, recompute
 preemption becomes nearly free — the resume prefill is mostly cache hits
 unless the pool was under enough pressure to really evict them.
+
+Chunked prefill (EngineConfig.max_prefill_tokens_per_step) splits an
+admitted prompt's uncached tail into block-aligned chunks fed over several
+engine steps: a sequence is admitted with its whole block table, stays
+`prefilling` while num_cached < prefill_len, and only joins the decode
+batch once the last chunk commits — so one long prompt never monopolizes
+an engine step, and decode latency for every in-flight request stays flat
+while the prompt streams in.
 """
 
 from __future__ import annotations
@@ -70,11 +78,31 @@ class Sequence:
         # (src, dst) device block copy. Admission holds an extra ref on src
         # until the copy lands.
         self.pending_copy: Optional[Tuple[int, int]] = None
+        # Chunked-prefill state machine: admitted → prefilling(offset =
+        # num_cached) → decoding. Set at admission to len(prefill_ids) at
+        # that moment; the sequence is mid-prefill while num_cached is
+        # below it (prefill_ids itself grows as tokens are generated, so
+        # the target must be pinned). A preempt-resume re-admission
+        # re-pins it, so resumes re-chunk.
+        self.prefill_len = 0
+        # Chunk dispatches since the current admission (0 = none yet); the
+        # engine uses it for first-chunk bookkeeping and chunk-indexed
+        # observability records.
+        self.num_chunks = 0
 
     @property
     def prefill_ids(self) -> List[int]:
         # After a preemption the generated suffix is recomputed as prompt.
         return self.request.prompt_ids + self.generated
+
+    @property
+    def prefilling(self) -> bool:
+        """True while an admitted sequence still has prompt tokens to feed
+        (chunked prefill spreads them over several engine steps). A
+        prefilling sequence holds its blocks and a decode slot but never
+        enters the decode/verify batch — it would read K/V that was never
+        computed."""
+        return self.is_running and self.num_cached < self.prefill_len
 
     @property
     def last_token(self) -> int:
@@ -150,9 +178,71 @@ class Scheduler:
                 break  # head-of-line blocking is deliberate: FIFO fairness
             self.waiting.popleft()
             seq.is_running = True
+            # Pin the chunking target: prefill_ids grows as the sequence
+            # generates, so "fully prefilled" must mean the length at
+            # admission, not the live property.
+            seq.prefill_len = len(seq.prefill_ids)
+            seq.num_chunks = 0
             admitted.append(seq)
             self.running.append(seq)
         return admitted
+
+    def schedule_prefill_chunks(
+        self, token_budget: Optional[int]
+    ) -> List[Tuple[Sequence, int]]:
+        """Plan this step's prefill work: walk the running list in arrival
+        order and give each still-prefilling sequence the next chunk of its
+        prompt, spending at most `token_budget` tokens across the step
+        (None = unlimited: each sequence's whole remainder in one chunk,
+        the pre-chunking behavior). Non-final chunks are rounded down to a
+        block boundary so every chunk but the last fills whole blocks
+        (prefix-cache publication and CoW stay block-aligned). The oldest
+        prefilling sequence always gets at least one block when any budget
+        remains, so chunked requests make monotonic progress; decode slots
+        are untouched — decode-ready sequences batch every step regardless
+        of how much prefill is in flight."""
+        plans: List[Tuple[Sequence, int]] = []
+        remaining = token_budget
+        for seq in self.running:
+            if not seq.prefilling:
+                continue
+            left = seq.prefill_len - seq.num_cached
+            if remaining is None:
+                take = left
+            else:
+                if remaining <= 0:
+                    break
+                take = min(left, remaining)
+                if take < left:
+                    # Keep the chunk block-aligned unless it finishes the
+                    # prompt. num_cached starts block-aligned (prefix
+                    # matches are whole blocks; the CoW case has a 1-token
+                    # remainder and never reaches here), so aligned takes
+                    # keep it aligned.
+                    take = (take // self.allocator.block_size) * (
+                        self.allocator.block_size
+                    )
+                    if take == 0:
+                        break
+                remaining -= take
+            plans.append((seq, take))
+        return plans
+
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens admitted or queued but not yet fed through a
+        prefill program: the chunked-prefill backlog gauge. O(waiting +
+        running), called once per engine step (lengths only — building
+        prefill_ids would copy every waiting prompt per step)."""
+        backlog = sum(
+            len(s.request.prompt_ids) + len(s.generated)
+            for s in self.waiting
+        )
+        backlog += sum(
+            s.prefill_len - s.num_cached
+            for s in self.running
+            if s.prefilling
+        )
+        return backlog
 
     def _admit(self, seq: Sequence) -> bool:
         """Map `seq`'s block table: share the longest cached block-prefix
@@ -208,12 +298,18 @@ class Scheduler:
     # ---------------- decode ----------------
 
     def schedule_decode(self) -> List[Sequence]:
-        """Ensure every running sequence owns a block for the position its
-        next token will be written to; preempt the youngest sequences on
-        cache pressure. Returns the surviving running list."""
+        """Ensure every decode-ready running sequence owns a block for the
+        position its next token will be written to; preempt the youngest
+        sequences on cache pressure. Returns the decode batch — running
+        sequences that are NOT still prefilling (a mid-chunk sequence holds
+        its slot and blocks but must not decode from K/V that was never
+        computed; admission already allocated its whole table, so it needs
+        no block here either)."""
         for seq in list(self.running):
             if not seq.is_running:
                 continue  # preempted by an earlier iteration of this loop
+            if seq.prefilling:
+                continue  # mid-chunk: no decode, no extra block needed
             needed = seq.num_cached // self.allocator.block_size + 1
             if needed > self.max_blocks_per_seq:
                 raise RuntimeError(
@@ -233,7 +329,7 @@ class Scheduler:
                     self.preempt(victim)
                     if victim is seq:
                         break
-        return list(self.running)
+        return [s for s in self.running if not s.prefilling]
 
     def reserve_speculative(self, seq: Sequence, num_tokens: int) -> int:
         """Extend `seq`'s block table so a verify step can write K/V for

@@ -258,6 +258,14 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             "Cumulative accepted / proposed speculative tokens",
             tag_keys=("engine",),
         ),
+        "prefill_backlog_tokens": get_or_create(
+            Gauge,
+            "llm_engine_prefill_backlog_tokens",
+            "Prompt tokens admitted or queued but not yet fed through a "
+            "prefill program (chunked prefill drains this at "
+            "max_prefill_tokens_per_step per engine step)",
+            tag_keys=("engine",),
+        ),
     }
     dead_letters = get_or_create(
         Gauge,

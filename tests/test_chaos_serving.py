@@ -343,6 +343,67 @@ def test_verify_fault_dead_letters_only_culprit_releases_draft_blocks():
     server.shutdown()
 
 
+def test_poisoned_chunk_dead_letters_only_culprit_releases_all_blocks():
+    """Chunked prefill: an injected failure at the engine.prefill_chunk
+    site MID-chunk-stream (the request's 2nd chunk, with a whole prompt's
+    worth of blocks already held and K/V partially scattered) dead-letters
+    ONLY the culprit — all of its blocks (allocated up front at admission)
+    are released in one abort, the draft mirror pool ends at boot size —
+    while concurrent generations finish token-identical and the engine
+    keeps chunking new work."""
+    draft_cfg = GPTConfig(
+        vocab_size=128, num_layers=1, num_heads=4, embed_dim=64,
+        max_seq_len=128, dtype=jnp.float32, attention_impl="reference",
+    )
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4,
+        max_blocks_per_seq=8, speculation="draft",
+        draft_model_config=draft_cfg,
+        max_prefill_tokens_per_step=16,
+    )
+    fi.inject(
+        "engine.prefill_chunk",
+        match="poison-me",
+        nth=2,  # fail on its SECOND chunk: mid-prompt, blocks held
+        exc_factory=lambda: RuntimeError("cosmic ray mid-chunk"),
+    )
+    server = LLMServer(TINY, ecfg, seed=0, warmup=False)
+    prompts = random_prompts((7, 6), seed=4)
+    poison_prompt = random_prompts((40,), seed=12)[0]  # 3 chunks of 16
+    jobs = [
+        ("ok-0", prompts[0], 10),
+        ("ok-1", [3, 4, 5] * 4, 10),  # repetitive: speculation engages
+        ("poison-me", poison_prompt, 10),
+    ]
+    results = _concurrent_generates(server, jobs)
+    poisoned = results["poison-me"]
+    assert isinstance(poisoned, PoisonRequestError)
+    assert "mid-chunk" in repr(poisoned.cause)
+    model = GPT(TINY)
+    params = server._engine.runner.params
+    for rid, prompt in (("ok-0", prompts[0]), ("ok-1", [3, 4, 5] * 4)):
+        out = results[rid]
+        assert not isinstance(out, BaseException), out
+        assert out["token_ids"] == reference_greedy(model, params, prompt, 10)
+    assert server.check_health() is True
+    letters = server.dead_letters()
+    assert [d["request_id"] for d in letters] == ["poison-me"]
+    assert letters[0]["tokens_generated"] == 0  # died before its 1st token
+    # Pool invariants: the culprit's WHOLE block table (admission
+    # allocates for the full prompt; chunk 1 had already scattered into
+    # it) went back, and the draft mirror pool is exactly at boot size.
+    assert server._engine.allocator.num_allocated == 0
+    assert server._engine._spec.allocator.num_allocated == 0
+    assert server._engine._spec._state == {}
+    # The engine keeps chunking new long prompts afterwards.
+    out = server.generate(poison_prompt, max_new_tokens=4, timeout_s=60.0)
+    assert out["token_ids"] == reference_greedy(
+        model, params, poison_prompt, 4
+    )
+    assert server._engine.stats()["chunked_prefill_requests"] >= 1
+    server.shutdown()
+
+
 # ---------------- router layer: failover + resume ----------------
 
 
@@ -494,6 +555,49 @@ def test_spec_midstream_replica_kill_stream_resumes_token_identical(
     assert stats["speculation"] == "ngram"
     assert stats["spec_verify_steps"] > 0
     assert stats["spec_accepted_tokens"] > 0
+
+
+def test_midstream_replica_kill_during_chunked_prefill_stream_resumes(
+    serve_ray,
+):
+    """A replica dying while a long prompt is still STREAMING IN as
+    chunks (killed at its very first stream item, before any token was
+    delivered) stream-resumes on another replica token-identically: the
+    resume re-submits the prompt, which re-chunks from scratch under the
+    same budget on the survivor."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4,
+        max_blocks_per_seq=8, prefill_buckets=(8, 32),
+        max_prefill_tokens_per_step=8,
+    )
+    handle = serve.run(
+        build_app(TINY, ecfg, engine_name="chaos-chunk", num_replicas=2),
+        name="llmchaos6",
+    )
+    prompt = random_prompts((26,), seed=13)[0]  # 4 chunks under budget 8
+    n_new = 6
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ecfg, seed=0).runner.params, prompt, n_new
+    )
+    spec = fi.inject(
+        "replica.stream_item",
+        nth=1,  # die delivering the FIRST token: prefill just chunked in
+        exc_factory=lambda: ActorDiedError(None, "injected mid-chunk kill"),
+    )
+    stream = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    tokens = [d["token_id"] for d in stream]
+    assert spec.fires == 1
+    assert tokens == want
+    # The prompt really chunked on the serving engine.
+    engine = ray_tpu.get_actor("llm_engine:chaos-chunk")
+    stats = ray_tpu.get(engine.metrics.remote())
+    assert stats["prefill_token_budget"] == 8
+    assert stats["chunked_prefill_requests"] >= 1
 
 
 def test_llm_stream_double_failover_token_identical(serve_ray):

@@ -2431,6 +2431,83 @@ def test_bucket_drift_between_two_tables_fires():
     assert "RTL805" not in rules_of(lint(subset))
 
 
+def test_chunk_width_table_subset_of_partial_prefill_buckets_is_clean():
+    """Chunked prefill's invariant, expressed to RTL805: the chunk-width
+    table (the widths the chunked warmup compiles) must stay a subset of
+    the partial-prefill bucket table (the widths the live path feeds).
+    Both tables resolve statically across modules; a strict subset is
+    exactly the legal shape (a budget caps which buckets chunks reach)."""
+    findings = lint_files(
+        {
+            "cfg.py": """
+                BUCKETS = (8, 16, 32)
+                # Budget 16: chunks only ever reach the first two buckets.
+                CHUNK_WIDTHS = (8, 16)
+
+                def bucket_for(n):
+                    for b in BUCKETS:
+                        if b >= n:
+                            return b
+                    raise ValueError(n)
+            """,
+            "runner.py": """
+                import jax
+                import jax.numpy as jnp
+                from cfg import BUCKETS, CHUNK_WIDTHS, bucket_for
+
+                def partial_prefill(t):
+                    return t
+
+                def warmup():
+                    f = jax.jit(partial_prefill)
+                    for w in CHUNK_WIDTHS:
+                        f(jnp.zeros((1, w), jnp.int32))
+
+                def serve_chunk(n):
+                    f = jax.jit(partial_prefill)
+                    f(jnp.zeros((1, bucket_for(n)), jnp.int32))
+            """,
+        }
+    )
+    assert "RTL805" not in {f.rule for f in findings}
+
+
+def test_chunk_width_table_drift_from_bucket_table_fires():
+    """Drift between the chunk-width table and the partial-prefill bucket
+    table = a guaranteed cold compile (warmup compiles widths the live
+    path never feeds, the live path feeds a width warmup never compiled)
+    — caught statically, in the module that drifted."""
+    findings = lint_files(
+        {
+            "cfg.py": """
+                BUCKETS = (8, 16, 32)
+                CHUNK_WIDTHS = (8, 24)  # 24 is not a bucket: drift
+            """,
+            "runner.py": """
+                import jax
+                import jax.numpy as jnp
+                from cfg import BUCKETS, CHUNK_WIDTHS
+
+                def partial_prefill(t):
+                    return t
+
+                def warmup():
+                    f = jax.jit(partial_prefill)
+                    for w in CHUNK_WIDTHS:
+                        f(jnp.zeros((1, w), jnp.int32))
+
+                def serve(n):
+                    f = jax.jit(partial_prefill)
+                    for b in BUCKETS:
+                        f(jnp.zeros((1, b), jnp.int32))
+            """,
+        }
+    )
+    hits = [f for f in findings if f.rule == "RTL805"]
+    assert hits and hits[0].path == "runner.py"
+    assert "drifted" in hits[0].message or "bucket table" in hits[0].message
+
+
 def test_bucket_coverage_unknown_width_stays_silent():
     """TOP case for RTL805: an unknown width (or an opaque whole shape)
     is never a provable cold compile."""

@@ -111,9 +111,15 @@ def test_engine_request_trace_connected_with_preempt_resume():
         assert r["parent_span_id"] is None or r["parent_span_id"] in span_ids
     n_preempts = len(by_name.get("llm.preempt", ()))
     assert n_preempts == eng.stats()["num_preemptions"]
-    # One queue wait + one prefill per admission (initial + every resume).
+    # One queue wait per admission (initial + every resume). Chunked
+    # prefill may split one admission over several llm.prefill spans, but
+    # exactly ONE of them per admission is final (produces the token).
     assert len(by_name["llm.queue"]) == len(prompts) + n_preempts
-    assert len(by_name["llm.prefill"]) == len(prompts) + n_preempts
+    finals = [
+        s for s in by_name["llm.prefill"] if s["attributes"]["final"]
+    ]
+    assert len(finals) == len(prompts) + n_preempts
+    assert len(by_name["llm.prefill"]) >= len(finals)
     # Resume prefills hit the victim's still-cached blocks (partial kind).
     kinds = {s["attributes"]["kind"] for s in by_name["llm.prefill"]}
     assert "full" in kinds and "partial" in kinds
@@ -243,12 +249,13 @@ def test_request_latency_histogram_counts_match_requests_served():
     impl = eng.stats()["attn_impl"]
     assert re.search(
         rf'llm_engine_step_seconds_bucket{{attn_impl="{impl}",'
-        rf'engine="{engine_tag}",le="\+Inf",phase="decode"}} \d+',
+        rf'chunk="n/a",engine="{engine_tag}",le="\+Inf",'
+        rf'phase="decode"}} \d+',
         text,
     )
     assert re.search(
         rf'llm_engine_step_seconds_count{{attn_impl="n/a",'
-        rf'engine="{engine_tag}",phase="prefill"}} \d+',
+        rf'chunk="final",engine="{engine_tag}",phase="prefill"}} \d+',
         text,
     )
 
@@ -260,25 +267,49 @@ def test_flight_recorder_step_records_and_warmup_compile_events():
     server = LLMServer(TINY, ECFG_SERVE, seed=0, warmup=True)
     record = server.flight_record()
     # Warmup charged each program/bucket with its cold-compile seconds.
+    # Under the default chunked-prefill budget only the chunk-reachable
+    # widths exist (ECFG_SERVE: budget 8 of max_model_len 32 → width 8;
+    # the 32 bucket can never dispatch, so warming it would be waste),
+    # and every (width × program) pair gets a chunk_prefill blame entry.
+    widths = ECFG_SERVE.chunk_widths()
+    assert widths == (8,)
     programs = {(c["program"], c["bucket"]) for c in record["compile_events"]}
-    assert ("prefill", 8) in programs and ("prefill", 32) in programs
+    assert ("prefill", 8) in programs
+    assert ("prefill", 32) not in programs  # unreachable under the budget
     assert any(p == "partial_prefill" for p, _ in programs)
     assert any(p == "cow" for p, _ in programs)
+    for w in widths:
+        assert ("chunk_prefill", w) in programs
     assert all(c["compile_s"] > 0 for c in record["compile_events"])
 
+    # Zero cold compiles during a chunked serve: warmup already compiled
+    # every program the chunked path can dispatch, so serving a prompt
+    # that chunks (9 tokens under a budget of 8) adds no jit cache entry.
+    runner = server._engine.runner
+    jit_fns = (
+        runner._prefill_fn, runner._prefill_suffix_fn, runner._decode_fn,
+        runner._copy_block_fn,
+    )
+    cache_sizes = [f._cache_size() for f in jit_fns]
     out = server.generate(
         random_prompts((9,), seed=5)[0], max_new_tokens=4, timeout_s=60.0
     )
     assert len(out["token_ids"]) == 4
+    assert [f._cache_size() for f in jit_fns] == cache_sizes
     steps = server.flight_record(steps_limit=8)["steps"]
     assert 0 < len(steps) <= 8
     prefill_steps = [s for s in steps if s["num_prefills"]]
     assert prefill_steps, steps
-    s = prefill_steps[-1]
-    assert s["phase"].startswith("prefill")
-    assert s["prefills"][0]["bucket"] == 32  # 9 tokens → the 32 bucket
-    assert s["tokens_in"] == 9
-    assert s["duration_s"] > 0
+    # The 9-token prompt streamed in as an 8-token chunk plus a 1-token
+    # final chunk, each within the budget, each in the width-8 bucket.
+    chunks = [p for s in prefill_steps for p in s["prefills"]]
+    assert [c["tokens"] for c in chunks] == [8, 1]
+    assert [c["final"] for c in chunks] == [False, True]
+    assert all(c["bucket"] == 8 for c in chunks)
+    for s in prefill_steps:
+        assert s["phase"].startswith("prefill")
+        assert s["tokens_in"] <= s["prefill_budget"]
+        assert s["duration_s"] > 0
     decode_steps = [s for s in steps if "decode" in s["phase"]]
     assert decode_steps and all(s["batch_size"] >= 1 for s in decode_steps)
     # The ring is bounded by config; a 0 limit means zero records.
