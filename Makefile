@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-changed lint-sarif lint-json test test-lint
+.PHONY: lint lint-changed lint-sarif lint-json test test-lint bench-serve-quick
 
 # Tree-clean gate: exit 1 on any active finding, untriaged baseline
 # entry, stale baseline entry, or parse error. Same entry point as the
@@ -31,3 +31,12 @@ test-lint:
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# Seeded ~30s CPU loadgen run through the real serve path. Exits nonzero
+# unless the SLO gate discriminates (the deliberately-loose spec passes
+# AND the deliberately-impossible one fails), loadgen/engine percentiles
+# agree within one histogram bucket, and the KV + draft pools drain back
+# to boot size — the end-to-end assertion of the harness machinery.
+bench-serve-quick:
+	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.loadgen.sweep sweep --quick \
+		--record-name BENCH_SERVE_quick --out /tmp/BENCH_SERVE_quick.json

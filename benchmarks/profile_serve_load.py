@@ -1,0 +1,20 @@
+"""Serving load benchmark entry point — thin wrapper over the traffic
+harness (`ray_tpu.loadgen.sweep`), kept here so the benchmarks/ directory
+stays the one place to look for every perf driver.
+
+    python benchmarks/profile_serve_load.py sweep --quick
+    python benchmarks/profile_serve_load.py run --config base --rate 8
+    python benchmarks/profile_serve_load.py report BENCH_SERVE_r01.json
+
+The full sweep (no --quick) is what records the BENCH_SERVE_r* rounds:
+every knob config (attn_impl x kv_cache_dtype x speculation x prefix
+caching x chunked prefill) at two open-loop arrival rates, gated on the
+loose/impossible SLO pair and the engine-histogram cross-check.
+"""
+
+import sys
+
+from ray_tpu.loadgen.sweep import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

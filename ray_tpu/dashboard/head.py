@@ -209,10 +209,41 @@ def _llm_engines_snapshot(runtime, steps_limit: int = 32) -> list:
                         ref, timeout=max(deadline - time.monotonic(), 0.05)
                     )
                 )
+                row["latency_percentiles"] = _llm_latency_percentiles(
+                    row.get("metrics", {}).get("engine_id")
+                )
             except Exception as exc:
                 row["error"] = repr(exc)
         rows.append(row)
     return rows
+
+
+def _llm_latency_percentiles(engine_id) -> dict:
+    """p50/p99 of the serving SLO trio + queue time, interpolated from the
+    request histograms the engine already exports (util.metrics
+    histogram_percentile — same helper the loadgen SLO gate reads). Engines
+    run in-process, so the panel reads the shared registry directly; a
+    series that has not observed yet reports null, never an error."""
+    from ray_tpu.util.metrics import histogram_percentile
+
+    out: dict = {}
+    if engine_id is None:
+        return out
+    tags = {"engine": engine_id}
+    for label, name in (
+        ("ttft_s", "llm_request_ttft_seconds"),
+        ("tpot_s", "llm_request_time_per_output_token_seconds"),
+        ("queue_s", "llm_request_queue_time_seconds"),
+        ("e2e_s", "llm_request_e2e_seconds"),
+    ):
+        try:
+            out[label] = {
+                "p50": histogram_percentile(name, 50.0, tags),
+                "p99": histogram_percentile(name, 99.0, tags),
+            }
+        except KeyError:
+            out[label] = {"p50": None, "p99": None}
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -982,6 +982,16 @@ class LLMEngine:
                 / max(self._spec_proposed_total, 1)
             ),
             "spec_verify_steps": self._verify_steps,
+            # Draft-mirror pool occupancy (0 without a stateful proposer):
+            # must return to 0 when no requests are in flight — leaked
+            # mirror blocks after aborts/disconnects show up here.
+            "spec_draft_pool_allocated": (
+                self._spec.allocator.num_allocated
+                if self._spec is not None
+                and getattr(self._spec, "allocator", None) is not None
+                else 0
+            ),
+            "kv_pool_allocated": self.allocator.num_allocated,
             # > 1.0 means verification is amortizing decode steps: tokens
             # emitted per verify-program dispatch, correction included.
             "spec_tokens_per_verify_step": (
@@ -1362,8 +1372,15 @@ class LLMServer:
             if state.error is not None:
                 raise state.error
         finally:
+            # Closed before exhaustion (consumer disconnected / stream task
+            # cancelled → GeneratorExit at the yield): the request is still
+            # occupying KV blocks (and, with speculation=draft, mirror
+            # blocks) to generate tokens nobody will read — abort it so the
+            # pool returns to steady state now. A finished request is no
+            # longer active, so the abort is a no-op on the normal path.
             with self._lock:
                 self._requests.pop(rid, None)
+                self._engine.abort(rid)
 
     def abort(self, request_id: str) -> bool:
         with self._lock:

@@ -82,7 +82,10 @@ class LLMIngress:
     stream) out.
 
     Request schema: {"prompt_ids": [int, ...], "max_new_tokens": int?,
-    "eos_id": int?, "stream": bool?}.
+    "eos_id": int?, "stream": bool?, "request_id": str?, "timeout_s":
+    float?} — timeout_s bounds the engine-side wait (total for blocking
+    requests, per-token gap for streams; load harnesses set it so a
+    collapsed engine fails requests instead of parking client threads).
     """
 
     def __init__(
@@ -109,19 +112,54 @@ class LLMIngress:
         max_new_tokens = request.get("max_new_tokens")
         eos_id = request.get("eos_id")
         request_id = request.get("request_id")
+        timeout_s = request.get("timeout_s")
+        kwargs = {} if timeout_s is None else {"timeout_s": float(timeout_s)}
         if request.get("stream"):
-            refs = self._engine.generate_stream.options(
-                num_returns="streaming"
-            ).remote(prompt_ids, max_new_tokens, eos_id, request_id)
+            # A mid-stream client disconnect must be able to abort the
+            # engine request (below), and abort is keyed by request_id —
+            # pin one now when the client didn't.
+            if request_id is None:
+                request_id = uuid.uuid4().hex
+            engine = self._engine
 
             def token_stream():
-                for ref in refs:
-                    yield {"token_id": ray_tpu.get(ref)}
+                # Client disconnect propagation: when the proxy/consumer
+                # closes this generator before exhaustion (GeneratorExit on
+                # stream cancel, or plain GC of an abandoned stream), the
+                # engine request is still decoding into its KV blocks — and
+                # with speculation=draft, into the draft-mirror blocks too.
+                # Abort it so those blocks free immediately instead of the
+                # engine generating max_new_tokens for nobody. The engine
+                # dispatch happens INSIDE the body: a never-started
+                # generator's finally would never run, so submitting here
+                # keeps "no consumer ever pulled" from leaking a request
+                # the abort could not cover.
+                refs = engine.generate_stream.options(
+                    num_returns="streaming"
+                ).remote(
+                    prompt_ids, max_new_tokens, eos_id, request_id, **kwargs
+                )
+                completed = False
+                try:
+                    for ref in refs:
+                        yield {"token_id": ray_tpu.get(ref)}
+                    completed = True
+                finally:
+                    if not completed:
+                        # Fire-and-forget: the abort's outcome is not
+                        # actionable here (a finished request no-ops), and
+                        # blocking the closing stream thread on a busy
+                        # engine's lock would serialize mass-disconnect
+                        # cleanup exactly under queueing collapse.
+                        try:
+                            _ = engine.abort.remote(request_id)
+                        except Exception:
+                            pass  # engine gone: its pool died with it
 
             return token_stream()
         return ray_tpu.get(
             self._engine.generate.remote(
-                prompt_ids, max_new_tokens, eos_id, request_id
+                prompt_ids, max_new_tokens, eos_id, request_id, **kwargs
             )
         )
 

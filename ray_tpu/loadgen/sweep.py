@@ -1,0 +1,485 @@
+"""Knob-space sweep: the BENCH_SERVE record producer and loadgen CLI.
+
+Walks the serving knobs the stack has accumulated (attn_impl ×
+kv_cache_dtype × speculation × prefix caching × chunked prefill) at
+several open-loop arrival rates, each cell driving the REAL serving path
+(serve.build_app → router → LLMIngress replica → shared engine actor)
+with a seeded mixed scenario, and emits a `BENCH_SERVE_r*.json`-style
+record: per-cell TTFT/TPOT p50/p99, achieved vs offered rate, error
+counts, engine-histogram cross-check, and SLO verdicts.
+
+Every cell also runs the gate pair — a deliberately-loose SLO that must
+PASS and a deliberately-impossible one that must FAIL — so the SLO
+machinery itself is asserted end-to-end on every bench run (`make
+bench-serve-quick` is the ~30s CI version).
+
+CPU convention (per the PR 7 rule): rows measured with
+attn_impl="pallas" on a CPU backend run the kernel in interpret mode —
+they are CPU-parity exercise only and are labeled `cpu_parity_only`;
+kernel speedup claims require a TPU box.
+
+Entry points: `python -m ray_tpu.loadgen.sweep ...` or
+`ray-tpu loadgen run|sweep|report`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional, Sequence, Tuple
+
+RECORD_SERIES = "BENCH_SERVE"
+
+# Engine geometry shared by every cell: small enough that warmup is
+# seconds on CPU, big enough that the mixed scenario exercises chunking,
+# preemption pressure, and multi-block prompts (max_model_len = 64).
+BASE_ENGINE = dict(
+    block_size=8,
+    num_blocks=96,
+    max_decode_slots=8,
+    max_blocks_per_seq=8,
+)
+
+# (label, EngineConfig overrides, cpu_parity_only). Labels are stable:
+# they key the trajectory across BENCH_SERVE_r* rounds.
+KNOB_CONFIGS: Tuple[Tuple[str, dict, bool], ...] = (
+    ("base", {}, False),
+    ("no_prefix_cache", {"enable_prefix_caching": False}, False),
+    ("no_chunked_prefill", {"max_prefill_tokens_per_step": 0}, False),
+    (
+        "spec_ngram",
+        {"speculation": "ngram", "num_speculative_tokens": 4},
+        False,
+    ),
+    ("int8_kv", {"kv_cache_dtype": "int8"}, False),
+    # Fused kernel on CPU = interpret mode: parity/latency-shape exercise
+    # only, never a speedup claim (PR 7 convention).
+    ("pallas_interpret", {"attn_impl": "pallas"}, True),
+)
+
+
+def serve_model_config():
+    """The small GPT every cell serves (seed-initialized weights; the
+    bench measures the serving machinery, not model quality)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(
+        vocab_size=128,
+        num_layers=2,
+        num_heads=4,
+        embed_dim=64,
+        max_seq_len=128,
+        dtype=jnp.float32,
+        attention_impl="reference",
+    )
+
+
+def _build_scenario(num_requests: int, seed: int):
+    from ray_tpu.llm.config import EngineConfig
+    from ray_tpu.loadgen.scenarios import ScenarioSpec
+
+    ecfg = EngineConfig(**BASE_ENGINE)
+    return ScenarioSpec.for_engine(
+        ecfg.max_model_len,
+        ecfg.buckets()[-1],
+        vocab_size=128,
+        name="mixed",
+        num_requests=num_requests,
+        seed=seed,
+    )
+
+
+def _drain_engine(handle, timeout_s: float = 60.0) -> dict:
+    """Wait until the engine has no queued/running work, then return its
+    final stats (the post-run pool/cache/speculation story)."""
+    metrics = handle.options(method_name="metrics")
+    deadline = time.monotonic() + timeout_s
+    stats = {}
+    while time.monotonic() < deadline:
+        stats = metrics.remote().result(timeout_s=30.0)
+        if stats.get("queue_depth", 0) == 0 and stats.get(
+            "num_running", 0
+        ) == 0:
+            return stats
+        time.sleep(0.25)
+    return stats
+
+
+def run_cell(
+    label: str,
+    overrides: dict,
+    cpu_parity_only: bool,
+    rate: float,
+    num_requests: int,
+    seed: int,
+    arrival_process: str = "poisson",
+    timeout_s: float = 30.0,
+) -> dict:
+    """One sweep cell: deploy, prime, drive the open-loop schedule,
+    report, gate, cross-check, tear down. Returns the cell record."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.config import EngineConfig
+    from ray_tpu.llm.serve import build_app
+    from ray_tpu.loadgen import report as report_mod
+    from ray_tpu.loadgen.arrivals import ArrivalSpec, arrival_times
+    from ray_tpu.loadgen.driver import run_open_loop
+    from ray_tpu.loadgen.scenarios import generate_requests
+    from ray_tpu.loadgen.slo import (
+        IMPOSSIBLE_SLO,
+        LOOSE_SLO,
+        SLOSpec,
+        evaluate_slo,
+    )
+
+    ecfg = EngineConfig(**{**BASE_ENGINE, **overrides})
+    engine_name = f"loadgen-{label}-r{rate:g}-s{seed}"
+    app_name = f"lg-{label}-r{rate:g}"
+    handle = serve.run(
+        build_app(
+            serve_model_config(),
+            ecfg,
+            engine_name=engine_name,
+            max_concurrent_queries=64,
+        ),
+        name=app_name,
+        _blocking_timeout_s=300.0,
+    )
+    try:
+        # Prime: one blocking request guarantees engine warmup finished
+        # before the measured window opens (replica health reads True
+        # while the engine actor is still compiling its buckets).
+        handle.remote(
+            {"prompt_ids": [1, 2, 3], "max_new_tokens": 2}
+        ).result(timeout_s=300.0)
+        engine_id = handle.options(method_name="metrics").remote().result(
+            timeout_s=30.0
+        )["engine_id"]
+
+        spec = _build_scenario(num_requests, seed)
+        requests = generate_requests(spec)
+        arrivals = ArrivalSpec(
+            process=arrival_process, rate=rate, seed=seed
+        )
+        offsets = arrival_times(arrivals, len(requests))
+
+        before = report_mod.engine_window(engine_id)
+        result = run_open_loop(
+            handle,
+            requests,
+            offsets,
+            timeout_s=timeout_s,
+            settle_timeout_s=max(timeout_s * 2, 60.0),
+        )
+        stats = _drain_engine(handle)
+        after = report_mod.engine_window(engine_id)
+
+        rep = report_mod.build_report(result)
+        engine_pcts = report_mod.engine_percentiles(before, after)
+        check = report_mod.cross_check(rep, engine_pcts, after)
+        target_slo = SLOSpec.from_bounds(
+            "cpu_interactive",
+            ttft_p99=1.0,
+            tpot_p99=0.25,
+            e2e_p99=5.0,
+            error_rate=0.25,
+        )
+        verdicts = {
+            s.name: evaluate_slo(s, rep)
+            for s in (LOOSE_SLO, IMPOSSIBLE_SLO, target_slo)
+        }
+        return {
+            "config": label,
+            "knobs": dict(overrides),
+            "cpu_parity_only": cpu_parity_only,
+            "attn_impl": stats.get("attn_impl"),
+            "kv_cache_dtype": stats.get("kv_cache_dtype"),
+            "rate": rate,
+            "arrival": arrivals.to_dict(),
+            "report": rep,
+            "engine_percentiles": engine_pcts,
+            "cross_check": check,
+            "slo": verdicts,
+            "engine": {
+                "wedged": stats.get("wedged"),
+                "dead_letters": stats.get("num_dead_letters"),
+                "kv_pool_allocated": stats.get("kv_pool_allocated"),
+                "spec_draft_pool_allocated": stats.get(
+                    "spec_draft_pool_allocated"
+                ),
+                "prefix_cache_hit_rate": stats.get(
+                    "prefix_cache_hit_rate"
+                ),
+                "preemptions": stats.get("num_preemptions"),
+                "spec_acceptance_rate": stats.get("spec_acceptance_rate"),
+                "spec_tokens_per_verify_step": stats.get(
+                    "spec_tokens_per_verify_step"
+                ),
+                "chunked_prefill_requests": stats.get(
+                    "chunked_prefill_requests"
+                ),
+            },
+        }
+    finally:
+        try:
+            eng = ray_tpu.get_actor(f"llm_engine:{engine_name}")
+            ray_tpu.kill(eng)
+        except Exception:
+            pass  # engine never came up / already gone
+        serve.shutdown()
+
+
+def _gate(cell: dict) -> List[str]:
+    """The per-cell hard assertions every sweep run re-proves: the SLO
+    gate must discriminate (loose passes, impossible fails), loadgen and
+    engine percentiles must agree within one bucket, the engine must
+    dead-letter exactly the poisons (dead letters == client-side
+    PoisonRequestErrors, no wedge), and the KV/draft pools must drain
+    back to boot size."""
+    problems = []
+    if not cell["slo"]["loose"]["passed"]:
+        problems.append(f"{cell['config']}@{cell['rate']}: loose SLO failed")
+    if cell["slo"]["impossible"]["passed"]:
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: impossible SLO passed"
+        )
+    if not cell["cross_check"].get("agreed", False):
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: loadgen/engine percentile "
+            "cross-check disagreed by more than one bucket"
+        )
+    if cell["engine"].get("kv_pool_allocated") not in (0, None):
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: KV pool did not drain "
+            f"(allocated={cell['engine']['kv_pool_allocated']})"
+        )
+    if cell["engine"].get("spec_draft_pool_allocated") not in (0, None):
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: draft mirror pool did not "
+            "drain"
+        )
+    if cell["engine"].get("wedged"):
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: engine wedged under load"
+        )
+    # Poison isolation: every dead letter must correspond to a client-side
+    # PoisonRequestError — more dead letters means a non-poison request
+    # was killed, fewer means a poison escaped the dead-letter path.
+    dead = cell["engine"].get("dead_letters")
+    poisons = cell["report"]["errors"].get("PoisonRequestError", 0)
+    if dead is not None and dead != poisons:
+        problems.append(
+            f"{cell['config']}@{cell['rate']}: {dead} dead letters but "
+            f"{poisons} client-side PoisonRequestErrors"
+        )
+    return problems
+
+
+def run_sweep(
+    rates: Sequence[float],
+    num_requests: int,
+    seed: int = 0,
+    configs: Optional[Sequence[str]] = None,
+    arrival_process: str = "poisson",
+    record_name: str = "BENCH_SERVE",
+) -> Tuple[dict, List[str]]:
+    """The full sweep. Returns (record, gate_problems)."""
+    import jax
+
+    chosen = [
+        c
+        for c in KNOB_CONFIGS
+        if configs is None or c[0] in set(configs)
+    ]
+    if configs is not None and len(chosen) != len(set(configs)):
+        known = [c[0] for c in KNOB_CONFIGS]
+        raise ValueError(
+            f"unknown config in {list(configs)}; choose from {known}"
+        )
+    backend = jax.default_backend()
+    cells = []
+    problems: List[str] = []
+    for label, overrides, parity in chosen:
+        for rate in rates:
+            cell = run_cell(
+                label,
+                overrides,
+                parity and backend != "tpu",
+                rate,
+                num_requests,
+                seed,
+                arrival_process=arrival_process,
+            )
+            cells.append(cell)
+            cell_problems = _gate(cell)
+            problems.extend(cell_problems)
+            rep = cell["report"]
+            p99 = rep["percentiles"]["ttft_s"].get("p99")
+            print(
+                f"[{record_name}] {label} @ {rate:g}/s: "
+                f"achieved {rep['achieved_rate']:.2f}/s, "
+                f"ttft_p99 {p99 if p99 is None else round(p99, 4)}s, "
+                f"errors {rep['num_errors']}"
+                + (f"  !! {cell_problems}" if cell_problems else "")
+            )
+    scenario = _build_scenario(num_requests, seed)
+    record = {
+        "record": record_name,
+        "series": RECORD_SERIES,
+        "backend": backend,
+        "note": (
+            "Open-loop driven through serve.build_app (router -> "
+            "LLMIngress replica -> shared engine actor). CPU rows with "
+            "cpu_parity_only=true run the pallas kernel in interpret "
+            "mode: parity exercise only, never a speedup claim."
+        ),
+        "engine_base": dict(BASE_ENGINE),
+        "scenario": scenario.to_dict(),
+        "rates": list(rates),
+        "cells": cells,
+        "gate_problems": problems,
+    }
+    return record, problems
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu loadgen",
+        description="open-loop serving load generator / SLO gate / sweep",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser(
+        "run", help="one scenario at one rate against one engine config"
+    )
+    p_run.add_argument(
+        "--config",
+        default="base",
+        choices=[c[0] for c in KNOB_CONFIGS],
+    )
+    p_run.add_argument("--rate", type=float, default=4.0)
+    p_run.add_argument(
+        "--process",
+        default="poisson",
+        choices=("poisson", "uniform", "onoff", "ramp"),
+    )
+    p_run.add_argument("--num-requests", type=int, default=32)
+    p_run.add_argument("--seed", type=int, default=0)
+    p_run.add_argument("--json-out", default=None)
+
+    p_sweep = sub.add_parser(
+        "sweep", help="knob-space sweep emitting a BENCH_SERVE record"
+    )
+    p_sweep.add_argument(
+        "--quick",
+        action="store_true",
+        help="~30s CI cut: base config, one rate, small n — still "
+        "asserts the loose/impossible SLO gate pair and the engine "
+        "cross-check",
+    )
+    p_sweep.add_argument("--rates", default=None, help="comma-separated")
+    p_sweep.add_argument("--num-requests", type=int, default=None)
+    p_sweep.add_argument("--seed", type=int, default=0)
+    p_sweep.add_argument(
+        "--configs", default=None, help="comma-separated config labels"
+    )
+    p_sweep.add_argument("--record-name", default="BENCH_SERVE")
+    p_sweep.add_argument("--out", default=None, help="record JSON path")
+
+    p_rep = sub.add_parser(
+        "report", help="summarize an existing BENCH_SERVE record"
+    )
+    p_rep.add_argument("path")
+
+    args = parser.parse_args(list(argv) if argv is not None else None)
+
+    if args.cmd == "report":
+        from ray_tpu.loadgen.report import format_report
+
+        with open(args.path) as f:
+            record = json.load(f)
+        for cell in record.get("cells", []):
+            parity = " [cpu-parity-only]" if cell.get("cpu_parity_only") else ""
+            print(f"== {cell['config']} @ {cell['rate']:g}/s{parity}")
+            print(
+                format_report(
+                    cell["report"], list(cell.get("slo", {}).values())
+                )
+            )
+        if record.get("gate_problems"):
+            print("gate problems:", record["gate_problems"])
+            return 1
+        return 0
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    try:
+        if args.cmd == "run":
+            cfg = next(c for c in KNOB_CONFIGS if c[0] == args.config)
+            cell = run_cell(
+                cfg[0],
+                cfg[1],
+                cfg[2],
+                args.rate,
+                args.num_requests,
+                args.seed,
+                arrival_process=args.process,
+            )
+            from ray_tpu.loadgen.report import format_report
+
+            print(
+                format_report(
+                    cell["report"], list(cell["slo"].values())
+                )
+            )
+            if args.json_out:
+                with open(args.json_out, "w") as f:
+                    json.dump(cell, f, indent=2)
+            problems = _gate(cell)
+            if problems:
+                print("GATE FAILURES:")
+                for p in problems:
+                    print(f"  {p}")
+                return 1
+            return 0
+
+        if args.quick:
+            rates = [6.0]
+            num_requests = args.num_requests or 24
+            configs = (
+                args.configs.split(",") if args.configs else ["base"]
+            )
+        else:
+            rates = [4.0, 12.0]
+            num_requests = args.num_requests or 48
+            configs = args.configs.split(",") if args.configs else None
+        if args.rates:
+            rates = [float(r) for r in args.rates.split(",")]
+        record, problems = run_sweep(
+            rates,
+            num_requests,
+            seed=args.seed,
+            configs=configs,
+            record_name=args.record_name,
+        )
+        out = args.out or f"{args.record_name}.json"
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+        print(f"wrote {out} ({len(record['cells'])} cells)")
+        if problems:
+            print("GATE FAILURES:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

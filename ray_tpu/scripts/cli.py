@@ -247,6 +247,23 @@ def cmd_lint(args) -> int:
     return _forward_lint(args.lint_args)
 
 
+def _forward_loadgen(rest: list) -> int:
+    """Hand everything after `loadgen` to the traffic harness's own
+    parser (ray_tpu/loadgen/sweep.py): `run` one scenario/rate cell,
+    `sweep` the knob space into a BENCH_SERVE record, `report` an
+    existing record. The harness boots its own runtime."""
+    from ray_tpu.loadgen.sweep import main as loadgen_main
+
+    rest = list(rest)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    return loadgen_main(rest)
+
+
+def cmd_loadgen(args) -> int:
+    return _forward_loadgen(args.loadgen_args)
+
+
 def main(argv: Optional[list] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "lint":
@@ -255,6 +272,10 @@ def main(argv: Optional[list] = None) -> int:
         # argparse.REMAINDER only engages after a positional). With global
         # flags before the subcommand, argparse dispatches to cmd_lint.
         return _forward_lint(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # Same verbatim-forward contract as lint: the harness owns its
+        # flags (`ray-tpu loadgen sweep --quick` must reach its parser).
+        return _forward_loadgen(argv[1:])
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="TPU-native distributed ML framework CLI"
     )
@@ -303,6 +324,17 @@ def main(argv: Optional[list] = None) -> int:
         "--list-rules)",
     )
 
+    p_lg = sub.add_parser(
+        "loadgen",
+        help="open-loop serving load generator: run / sweep / report",
+    )
+    p_lg.add_argument(
+        "loadgen_args",
+        nargs=argparse.REMAINDER,
+        help="subcommand and flags forwarded to the harness "
+        "(run --rate ..., sweep --quick, report FILE)",
+    )
+
     p_logs = sub.add_parser("logs", help="tail aggregated worker logs")
     p_logs.add_argument(
         "--address", required=True, help="head connect string host:port?token=..."
@@ -338,6 +370,7 @@ def main(argv: Optional[list] = None) -> int:
         "job": cmd_job,
         "metrics": cmd_metrics,
         "lint": cmd_lint,
+        "loadgen": cmd_loadgen,
         "start": cmd_start,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,

@@ -215,6 +215,86 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+def percentile_from_buckets(
+    boundaries: Sequence[float], buckets: Sequence[int], q: float
+) -> Optional[float]:
+    """q-th percentile (q in [0, 100]) from histogram bucket counts, with
+    linear interpolation inside the containing bucket (the decade-ladder
+    boundaries are coarse, so nearest-rank alone would quantize every
+    percentile to a bucket edge). `buckets` has len(boundaries) + 1 counts;
+    the final count is the overflow (+Inf) bucket. Following the Prometheus
+    histogram_quantile convention, a percentile landing in the overflow
+    bucket returns the highest finite boundary — there is no upper edge to
+    interpolate toward. Returns None when the series has no samples."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile must be in [0, 100], got {q}")
+    if len(buckets) != len(boundaries) + 1:
+        raise ValueError(
+            f"expected {len(boundaries) + 1} bucket counts for "
+            f"{len(boundaries)} boundaries, got {len(buckets)}"
+        )
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    rank = (q / 100.0) * total
+    cum = 0
+    for i, n in enumerate(buckets[:-1]):
+        if n and cum + n >= rank:
+            lo = 0.0 if i == 0 else boundaries[i - 1]
+            hi = boundaries[i]
+            fraction = min(max((rank - cum) / n, 0.0), 1.0)
+            return lo + fraction * (hi - lo)
+        cum += n
+    return float(boundaries[-1])  # overflow bucket: clamp (Prometheus)
+
+
+def histogram_snapshot(name: str, tags: Optional[dict] = None) -> dict:
+    """Bucket counts / sum / count for ONE series of a registered
+    histogram: {"boundaries", "buckets", "sum", "count"} (zeros when the
+    series has not been observed yet). The loadgen report diffs two
+    snapshots to percentile just one run's window out of a long-lived
+    engine's cumulative histogram."""
+    with _REGISTRY_LOCK:
+        m = _REGISTRY.get(name)
+    if m is None:
+        raise KeyError(f"no metric named {name!r} is registered")
+    if not isinstance(m, Histogram):
+        raise TypeError(f"metric {name!r} is a {m.kind}, not a histogram")
+    key = m._merged(tags)
+    with m._lock:
+        buckets = list(
+            m._buckets.get(key, [0] * (len(m.boundaries) + 1))
+        )
+        return {
+            "boundaries": list(m.boundaries),
+            "buckets": buckets,
+            "sum": m._sums.get(key, 0.0),
+            "count": m._counts.get(key, 0),
+        }
+
+
+def histogram_percentile(
+    name: str, q: float, tags: Optional[dict] = None
+) -> Optional[float]:
+    """q-th percentile (q in [0, 100]) of one series of a registered
+    histogram, linearly interpolated within its decade-ladder buckets (see
+    percentile_from_buckets). The SLO gate and the dashboard both read
+    p50/p99 from the existing llm_request_* histograms through this.
+    Returns None when the series has no samples."""
+    snap = histogram_snapshot(name, tags)
+    return percentile_from_buckets(snap["boundaries"], snap["buckets"], q)
+
+
+def bucket_index(boundaries: Sequence[float], value: float) -> int:
+    """Which bucket of `boundaries` a value falls in (last index = the
+    overflow bucket) — mirrors Histogram.observe's inclusive-`le`
+    placement. Two latency estimates "agree within one bucket" when their
+    indices differ by at most 1 (the cross-check contract between
+    loadgen-side samples and engine-side histogram percentiles).
+    `boundaries` must be ascending, as Histogram already guarantees."""
+    return bisect_left(boundaries, value)
+
+
 def get_or_create(kind_cls, name: str, description: str = "", **kwargs):
     """Return the already-registered metric of this name/kind, or create it.
 

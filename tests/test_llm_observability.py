@@ -448,8 +448,14 @@ def test_streamed_request_yields_one_connected_trace(serve_ray):
     reqs = by_name["llm.request"]
     assert len(reqs) == 2
     assert all(r["attributes"]["status"] == "ok" for r in reqs)
-    resumed = min(reqs, key=lambda r: r["attributes"]["generated_tokens"])
+    resumed = max(reqs, key=lambda r: r["attributes"]["prompt_tokens"])
     assert resumed["attributes"]["prompt_tokens"] == len(prompt) + 4
+    # The orphaned original no longer drains to completion: the dying
+    # replica's token_stream closed before exhaustion, which propagates
+    # an engine abort (the mid-stream disconnect path), so its root span
+    # records an aborted finish instead of running out max_new_tokens.
+    orphan = min(reqs, key=lambda r: r["attributes"]["prompt_tokens"])
+    assert orphan["attributes"]["finish_reason"] == "aborted"
     # Queue → prefill → decode phases present for each request root.
     for req in reqs:
         children = {

@@ -305,6 +305,72 @@ def test_histogram_exposition_buckets_sum_count():
     assert "# TYPE exp_s histogram" in text
 
 
+def test_percentile_from_buckets_linear_interpolation():
+    """Known sample sets: the percentile interpolates linearly INSIDE the
+    containing bucket (nearest-rank alone would quantize every answer to a
+    bucket edge on the coarse decade ladders)."""
+    boundaries = [0.01, 0.1, 1.0]
+    # 10 samples, all in the (0.01, 0.1] bucket: rank p50 = 5 of 10 →
+    # halfway through the bucket span.
+    buckets = [0, 10, 0, 0]
+    p50 = metrics.percentile_from_buckets(boundaries, buckets, 50)
+    assert abs(p50 - (0.01 + 0.5 * 0.09)) < 1e-12
+    # Split 4 / 6 across the first two buckets: p50 rank 5 lands 1 sample
+    # into the second bucket's 6 → 1/6 of the way through (0.01, 0.1].
+    buckets = [4, 6, 0, 0]
+    p50 = metrics.percentile_from_buckets(boundaries, buckets, 50)
+    assert abs(p50 - (0.01 + (1 / 6) * 0.09)) < 1e-12
+    # p25 rank 2.5 of 10 lands inside the first bucket (lower edge 0.0).
+    p25 = metrics.percentile_from_buckets(boundaries, buckets, 25)
+    assert abs(p25 - (2.5 / 4) * 0.01) < 1e-12
+    # Empty series has no percentiles.
+    assert metrics.percentile_from_buckets(boundaries, [0, 0, 0, 0], 99) is None
+    with pytest.raises(ValueError, match="percentile"):
+        metrics.percentile_from_buckets(boundaries, buckets, 150)
+    with pytest.raises(ValueError, match="bucket counts"):
+        metrics.percentile_from_buckets(boundaries, [1, 2], 50)
+
+
+def test_percentile_from_buckets_overflow_clamps():
+    """A percentile landing in the +Inf bucket has no upper edge to
+    interpolate toward: it clamps to the highest finite boundary (the
+    Prometheus histogram_quantile convention)."""
+    boundaries = [0.01, 0.1, 1.0]
+    assert metrics.percentile_from_buckets(boundaries, [0, 0, 0, 4], 99) == 1.0
+    # Mixed: p50 in a finite bucket, p99 in the overflow.
+    buckets = [0, 8, 0, 2]
+    assert metrics.percentile_from_buckets(boundaries, buckets, 99) == 1.0
+    p50 = metrics.percentile_from_buckets(boundaries, buckets, 50)
+    assert 0.01 < p50 <= 0.1
+
+
+def test_histogram_percentile_reads_registered_series():
+    """histogram_percentile reads one tagged series of a live registry
+    histogram — the path the SLO gate and the dashboard panel share."""
+    metrics.clear_registry()
+    h = metrics.Histogram(
+        "pct_s", boundaries=[0.01, 0.1, 1.0], tag_keys=("engine",)
+    )
+    for _ in range(10):
+        h.observe(0.05, tags={"engine": "a"})
+    for _ in range(10):
+        h.observe(0.5, tags={"engine": "b"})
+    pa = metrics.histogram_percentile("pct_s", 50, tags={"engine": "a"})
+    pb = metrics.histogram_percentile("pct_s", 50, tags={"engine": "b"})
+    assert 0.01 < pa <= 0.1
+    assert 0.1 < pb <= 1.0
+    # Unobserved series and missing/other-kind metrics are explicit.
+    assert (
+        metrics.histogram_percentile("pct_s", 50, tags={"engine": "zz"})
+        is None
+    )
+    with pytest.raises(KeyError):
+        metrics.histogram_percentile("never_registered", 50)
+    metrics.Counter("not_a_hist")
+    with pytest.raises(TypeError):
+        metrics.histogram_percentile("not_a_hist", 50)
+
+
 def test_histogram_exposition_tagged_series_independent():
     """Tagged histogram series render independently: each tag-set gets its
     own _bucket/_sum/_count family, with the le label merged into the
