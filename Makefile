@@ -32,11 +32,14 @@ test-lint:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
-# Seeded ~30s CPU loadgen run through the real serve path. Exits nonzero
+# Seeded ~45s CPU loadgen run through the real serve path. Exits nonzero
 # unless the SLO gate discriminates (the deliberately-loose spec passes
 # AND the deliberately-impossible one fails), loadgen/engine percentiles
 # agree within one histogram bucket, and the KV + draft pools drain back
 # to boot size — the end-to-end assertion of the harness machinery.
+# Includes the drain cell: a scale-down fired mid-run under open-loop
+# traffic must drop zero requests and take exactly one replica through
+# DRAINING -> STOPPED with the pools back at boot size.
 bench-serve-quick:
 	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.loadgen.sweep sweep --quick \
 		--record-name BENCH_SERVE_quick --out /tmp/BENCH_SERVE_quick.json

@@ -4,8 +4,11 @@ Hot paths call ``maybe_fail(site, detail)`` at named injection points — the
 engine step (`llm.step`, `llm.prefill`, `llm.decode.seq`, `engine.verify`
 for the speculative-decoding commit section), the Serve replica
 (`replica.handle_request`, `replica.handle_request_streaming`,
-`replica.stream_item`), actor-task submission (`actor.submit`), and replica
-startup (`controller.start_replica`). With no faults configured the call is
+`replica.stream_item`, `replica.drain`), actor-task submission
+(`actor.submit`), and the controller's replica lifecycle
+(`controller.start_replica`, `controller.drain_replica` — a fault in the
+drain conversation must degrade to the plain kill path, with clients
+covered by ActorDiedError failover). With no faults configured the call is
 one truthiness check, so the sites are safe to leave in production code.
 
 Faults are configured either programmatically::

@@ -23,10 +23,15 @@ Endpoints:
   GET /api/metrics_history[?limit=&since=]   gauge-suite timeseries ring
   GET /api/llm[?steps=]      LLM engine panel: stats, flight recorder,
                              dead letters, per named engine actor
+  GET /api/serve             Serve control-plane panel: per-deployment
+                             replica lifecycle states (STARTING/RUNNING/
+                             DRAINING), transition history, drain durations,
+                             drained/migrated counts, autoscaling signals
   GET /api/train[?rounds=]   training-run panel: round records, per-phase
                              breakdown, straggler flags, per recent fit()
-  GET /metrics               prometheus text exposition (runtime gauges AND
-                             LLM engine gauges refreshed at scrape time)
+  GET /metrics               prometheus text exposition (runtime gauges,
+                             LLM engine gauges, AND serve replica-state
+                             gauges refreshed at scrape time)
 """
 
 from __future__ import annotations
@@ -56,6 +61,7 @@ _PAGE = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Task summary</h2><table id="tasks"></table>
+<h2>Serve deployments</h2><div id="serve">none</div>
 <h2>LLM engines</h2><div id="llm">none</div>
 <h2>Train runs</h2><div id="train">none</div>
 <h2>History <span id="hist_legend" style="font-size:.75rem;font-weight:normal"></span></h2>
@@ -114,6 +120,35 @@ function renderLLM(engines){
       (fails?`<ul style="font-size:.8rem">${fails}</ul>`:'');
   }).join('<hr>');
 }
+function renderServe(apps){
+  const el=document.getElementById('serve');
+  if(apps.error){el.innerHTML=`<span class=bad>${esc(apps.error)}</span>`;return}
+  const rows=[];
+  for(const [app,deps] of Object.entries(apps)){
+    for(const [dep,d] of Object.entries(deps)){
+      const sc=d.state_counts||{};
+      const states=['STARTING','RUNNING','DRAINING'].map(s=>{
+        const n=sc[s]||0;
+        return n?`${s.toLowerCase()} ${s==='DRAINING'?'<span class=bad>'+n+'</span>':n}`:'';
+      }).filter(Boolean).join(' · ')||'no replicas';
+      const ds=d.drain_seconds||{};
+      const hist=(d.history||[]).slice(-6).map(h=>
+        `${esc(h.tag.split('#').pop())}:${esc(h.state)}`).join(' → ');
+      const sig=d.autoscaling_signals;
+      rows.push(`<p><b class=mono>${esc(app)}#${esc(dep)}</b> · `+
+        `${d.status==='HEALTHY'?'<span class=ok>HEALTHY</span>':'<span class=bad>'+esc(d.status)+'</span>'} · `+
+        `target ${d.target_replicas} · ${states} · `+
+        `drained ${d.num_drained_replicas} replicas / ${d.num_migrated_requests} migrated streams`+
+        (ds.p50!=null?` · drain p50 ${(ds.p50*1e3).toFixed(0)}ms p99 ${(ds.p99*1e3).toFixed(0)}ms`:'')+
+        (sig?`<br><span style="font-size:.8rem">slo window: queue p99 ${sig.queue_time_p99_s==null?'—':(sig.queue_time_p99_s*1e3).toFixed(1)+'ms'} · `+
+          `ttft p99 ${sig.ttft_p99_s==null?'—':(sig.ttft_p99_s*1e3).toFixed(1)+'ms'} · `+
+          `backlog ${sig.prefill_backlog_tokens} tok</span>`:'')+
+        (hist?`<br><span style="font-size:.8rem" class=mono>${hist}</span>`:'')+
+        `</p>`);
+    }
+  }
+  el.innerHTML=rows.join('')||'none';
+}
 function renderTrain(runs){
   const el=document.getElementById('train');
   if(!runs.length){el.textContent='none';return}
@@ -163,6 +198,7 @@ async function refresh(){
          ['actor_id','class_name','state','name','num_restarts']);
     const s=await j('/api/task_summary');
     fill('tasks', Object.entries(s).map(([k,v])=>({task:k,count:v})));
+    renderServe(await j('/api/serve'));
     renderLLM(await j('/api/llm?steps=12'));
     renderTrain(await j('/api/train?rounds=8'));
     const logs=await j('/api/logs?limit=200');
@@ -175,6 +211,48 @@ async function refresh(){
 }
 refresh();
 </script></body></html>"""
+
+
+def _serve_snapshot(runtime) -> dict:
+    """The controller's replica-lifecycle observability plus drain-duration
+    percentiles from the serve_replica_drain_seconds histogram (same
+    in-process registry read as the LLM latency panel). Controller
+    failures degrade to an error field, never a 500."""
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    existing = runtime.controller.get_named_actor(
+        CONTROLLER_NAME, runtime.namespace
+    )
+    if existing is None:
+        return {}
+    import ray_tpu
+    from ray_tpu.actor import ActorHandle
+    from ray_tpu.util.metrics import histogram_percentile
+
+    try:
+        obs = ray_tpu.get(
+            ActorHandle(
+                existing, "ServeControllerActor"
+            ).get_observability.remote(),
+            timeout=2.0,
+        )
+    except Exception as exc:
+        return {"error": repr(exc)}
+    for app_name, deps in obs.items():
+        for dep_name, dep in deps.items():
+            tags = {"app": app_name, "deployment": dep_name}
+            try:
+                dep["drain_seconds"] = {
+                    "p50": histogram_percentile(
+                        "serve_replica_drain_seconds", 50.0, tags
+                    ),
+                    "p99": histogram_percentile(
+                        "serve_replica_drain_seconds", 99.0, tags
+                    ),
+                }
+            except KeyError:
+                dep["drain_seconds"] = {"p50": None, "p99": None}
+    return obs
 
 
 def _llm_engines_snapshot(runtime, steps_limit: int = 32) -> list:
@@ -353,6 +431,8 @@ class _Handler(BaseHTTPRequestHandler):
                     runtime, steps_limit=int(q.get("steps", 32))
                 )
             )
+        elif path == "/api/serve":
+            self._json(_serve_snapshot(runtime))
         elif path == "/api/train":
             from ray_tpu.train.observability import list_runs
 
@@ -366,10 +446,12 @@ class _Handler(BaseHTTPRequestHandler):
             from ray_tpu.util.runtime_metrics import (
                 sample_llm_engine_metrics,
                 sample_runtime_metrics,
+                sample_serve_metrics,
             )
 
             sample_runtime_metrics(runtime)  # scrape-time freshness
             sample_llm_engine_metrics(runtime)  # idle engines stay current
+            sample_serve_metrics(runtime)  # replica lifecycle-state gauges
             self._send(200, metrics.prometheus_text().encode(), "text/plain")
         else:
             self._send(404, b"not found", "text/plain")

@@ -99,6 +99,17 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+class ReplicaDrainingError(ActorUnavailableError):
+    """A Serve replica marked DRAINING rejected a new dispatch, or
+    interrupted an in-flight stream at its drain deadline. Subclasses
+    ActorUnavailableError so the router's existing failover path
+    re-dispatches (and, for streams with a stream_resume_fn,
+    stream-resumes) onto a surviving replica WITHOUT waiting for the
+    draining replica to actually die — the router additionally treats it
+    as a planned migration rather than a failure, so it never consumes
+    the request's retry budget."""
+
+
 class ReplicaUnavailableRetryExhausted(ActorError):
     """The Serve router's client-side failover gave up: every dispatch of a
     request within its retry budget landed on a dead/unavailable replica.

@@ -1393,6 +1393,33 @@ class LLMServer:
             stats["consecutive_step_failures"] = self._consecutive_step_failures
             return stats
 
+    def autoscaling_snapshot(self) -> dict:
+        """Compact SLO signal bundle for the serve controller's
+        LLMAutoscalingPolicy: the engine's queue-time and TTFT histogram
+        series (snapshotted engine-side so the numbers are correct even
+        when the engine actor runs out-of-process from the controller)
+        plus the prefill backlog and load counts. The controller diffs
+        two snapshots to get a look-back window — scale-up triggers on
+        RECENT p99, not the engine's lifetime percentile."""
+        with self._lock:
+            e = self._engine
+            return {
+                "engine_id": e._metric_tags["engine"],
+                "queue_depth": len(e.scheduler.waiting),
+                "num_running": len(e.scheduler.running),
+                # Decode occupancy bound: num_running at max_decode_slots
+                # means the engine is decode-SATURATED even when the
+                # admission-time histograms are silent (long generations,
+                # no new arrivals) — the policy must not read that
+                # silence as idleness and scale the fleet down.
+                "max_decode_slots": e.engine_config.max_decode_slots,
+                "prefill_backlog_tokens": int(
+                    e.scheduler.prefill_backlog_tokens()
+                ),
+                "queue_time": e._h_queue.snapshot(e._metric_tags),
+                "ttft": e._h_ttft.snapshot(e._metric_tags),
+            }
+
     def dead_letters(self) -> List[dict]:
         """Records of requests failed in isolation after poisoning an
         engine step (id, prompt hash, error, step), oldest first."""

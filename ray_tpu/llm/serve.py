@@ -8,8 +8,9 @@ actor streaming-generator path into Serve's ndjson/`stream=True` plumbing.
 
 from __future__ import annotations
 
+import time
 import uuid
-from typing import Optional
+from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu import serve
@@ -88,6 +89,17 @@ class LLMIngress:
     collapsed engine fails requests instead of parking client threads).
     """
 
+    # Minimum gap between engine autoscaling_snapshot RPCs: the controller
+    # polls replica metrics every reconcile pass (~50ms) and N replicas
+    # share one engine — without the cache the engine's lock would see
+    # 20/s x replicas control-plane acquisitions.
+    AUTOSCALING_METRICS_TTL_S = 0.25
+    # Last-good fallback age cap: past this, a degraded engine's frozen
+    # snapshot stops being replayed to the controller as fresh — the
+    # autoscaler sees a signal GAP (holds current count) instead of
+    # stale absolute values that could pin scale decisions indefinitely.
+    AUTOSCALING_METRICS_STALE_S = 5.0
+
     def __init__(
         self,
         engine_name: str = "default",
@@ -101,6 +113,8 @@ class LLMIngress:
             engine_name, model_config, engine_config, params=params,
             seed=seed, draft_params=draft_params,
         )
+        self._as_snapshot: Optional[dict] = None
+        self._as_snapshot_t = 0.0
 
     def __call__(self, request: dict):
         if not isinstance(request, dict) or "prompt_ids" not in request:
@@ -165,6 +179,32 @@ class LLMIngress:
 
     def metrics(self) -> dict:
         return ray_tpu.get(self._engine.metrics.remote())
+
+    def autoscaling_metrics(self) -> dict:
+        """SLO signals for the controller's LLMAutoscalingPolicy, riding
+        the replica metrics poll (ReplicaActor.get_metrics calls this):
+        the engine's queue-time/TTFT histogram snapshots and prefill
+        backlog (LLMServer.autoscaling_snapshot). TTL-cached; on an engine
+        timeout the last good snapshot is returned — a busy engine is
+        exactly when the autoscaler most needs a (slightly stale) signal,
+        not a gap."""
+        now = time.monotonic()
+        if (
+            self._as_snapshot is not None
+            and now - self._as_snapshot_t < self.AUTOSCALING_METRICS_TTL_S
+        ):
+            return self._as_snapshot
+        try:
+            snap = ray_tpu.get(
+                self._engine.autoscaling_snapshot.remote(), timeout=1.0
+            )
+        except Exception:
+            if now - self._as_snapshot_t > self.AUTOSCALING_METRICS_STALE_S:
+                return {}
+            return self._as_snapshot or {}
+        self._as_snapshot = snap
+        self._as_snapshot_t = now
+        return snap
 
     def dead_letters(self) -> list:
         """Records of requests failed in isolation after poisoning an
@@ -231,10 +271,19 @@ def build_app(
     max_concurrent_queries: int = 32,
     seed: int = 0,
     draft_params=None,
+    autoscaling_config: Any = None,
+    graceful_shutdown_timeout_s: Optional[float] = None,
 ) -> serve.Application:
     """Bind the LLM ingress for `serve.run` (HTTP via the existing proxy:
     POST /<app> with the request JSON). Pass trained weights via `params`;
     without them the engine serves a seed-initialized model.
+
+    `autoscaling_config` accepts serve.LLMAutoscalingPolicy (SLO-driven:
+    the ingress feeds the engine's queue-time/TTFT histogram windows and
+    prefill backlog to the controller) or the queue-depth
+    AutoscalingConfig; `graceful_shutdown_timeout_s` bounds how long a
+    draining replica's in-flight streams may run before being
+    stream-resumed onto surviving replicas.
 
     Each build_app call gets its own engine actor by default — the engine
     is keyed by `engine_name`, so two apps share one engine (one copy of
@@ -249,6 +298,17 @@ def build_app(
         num_replicas=num_replicas,
         max_concurrent_queries=max_concurrent_queries,
     )
+    if autoscaling_config is not None or graceful_shutdown_timeout_s is not None:
+        deployment = deployment.options(
+            autoscaling_config=autoscaling_config,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+        )
+    # Declare the LLM stream-resume policy ON the deployment: handles
+    # built from its config (serve.run's return, get_app_handle, and the
+    # HTTP proxy's streaming path) migrate interrupted token streams onto
+    # surviving replicas — HTTP clients survive drains/kills too, without
+    # opting in per handle.
+    deployment = deployment.options(stream_resume_fn=llm_stream_resume)
     return deployment.bind(
         engine_name, model_config, engine_config, params=params, seed=seed,
         draft_params=draft_params,

@@ -18,6 +18,7 @@ from ray_tpu.loadgen.arrivals import PROCESSES, ArrivalSpec, arrival_times
 from ray_tpu.loadgen.driver import (
     LoadRunResult,
     RequestSample,
+    ScheduledEvent,
     arm_poison_faults,
     run_open_loop,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "SLORule",
     "SLOSpec",
     "ScenarioSpec",
+    "ScheduledEvent",
     "arm_poison_faults",
     "arrival_times",
     "build_report",

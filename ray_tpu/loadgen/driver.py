@@ -29,7 +29,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from ray_tpu._private import fault_injection as fi
 from ray_tpu.loadgen.scenarios import LoadRequest
@@ -56,9 +56,46 @@ class RequestSample:
     num_tokens: int = 0
     error: Optional[str] = None  # exception class name, None on success
     disconnected: bool = False
+    # Populated only with record_tokens=True: the exact delivered token
+    # ids, so chaos runs can assert migrated streams token-identical to an
+    # undisturbed run.
+    token_ids: Optional[List[int]] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ScheduledEvent:
+    """A control-plane action fired mid-run at a schedule offset (chaos
+    gating: scale events under live open-loop traffic). `fn` runs on its
+    own timer thread; outcome lands in `fired_s`/`error` and rides the
+    run result."""
+
+    offset_s: float
+    name: str
+    fn: Callable[[], None]
+    fired_s: Optional[float] = None
+    error: Optional[str] = None
+    # Set by run_open_loop when the settle window closed before the
+    # event's offset: the timer thread then stands down instead of firing
+    # a control-plane action against post-run (or the next run's) state.
+    # The lock makes cancel-vs-fire atomic — an event is either fired
+    # (fired_s set, never cancelled) or cancelled (never fires), so the
+    # serialized record can't read both.
+    cancelled: bool = False
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def to_dict(self) -> dict:
+        return {
+            "offset_s": self.offset_s,
+            "name": self.name,
+            "fired_s": self.fired_s,
+            "error": self.error,
+            "cancelled": self.cancelled,
+        }
 
 
 @dataclasses.dataclass
@@ -69,6 +106,7 @@ class LoadRunResult:
     offered_duration_s: float  # last scheduled arrival
     wall_duration_s: float  # fire of first request → last sample settled
     offered_rate: float
+    events: List[ScheduledEvent] = dataclasses.field(default_factory=list)
 
     @property
     def completed(self) -> List[RequestSample]:
@@ -89,6 +127,7 @@ class LoadRunResult:
             "wall_duration_s": self.wall_duration_s,
             "offered_rate": self.offered_rate,
             "achieved_rate": self.achieved_rate,
+            "events": [e.to_dict() for e in self.events],
         }
 
 
@@ -98,15 +137,25 @@ def _drive_one(
     sample: RequestSample,
     t0: float,
     timeout_s: float,
+    stream_resume_fn: Optional[Callable] = None,
+    record_tokens: bool = False,
 ) -> None:
     """Consume one streamed request on its own thread. Timestamps are
     perf_counter offsets from the run origin `t0` (monotonic durations —
-    wall clock would corrupt the percentiles under NTP steps)."""
+    wall clock would corrupt the percentiles under NTP steps). With a
+    `stream_resume_fn` (e.g. llm_stream_resume), a replica dying or
+    draining mid-stream migrates the stream to a surviving replica
+    instead of erroring the sample."""
     sample.sent_s = time.perf_counter() - t0
     first = last = None
     n = 0
+    if record_tokens:
+        sample.token_ids = []
     try:
-        gen = handle.options(stream=True).remote(
+        opts = {"stream": True}
+        if stream_resume_fn is not None:
+            opts["stream_resume_fn"] = stream_resume_fn
+        gen = handle.options(**opts).remote(
             {
                 "prompt_ids": list(req.prompt_ids),
                 "max_new_tokens": req.max_new_tokens,
@@ -126,6 +175,10 @@ def _drive_one(
                 first = now
             last = now
             n += 1
+            if record_tokens:
+                sample.token_ids.append(
+                    item.get("token_id") if isinstance(item, dict) else item
+                )
             if (
                 req.disconnect_after is not None
                 and n >= req.disconnect_after
@@ -165,12 +218,29 @@ def arm_poison_faults(requests: Sequence[LoadRequest]) -> List[fi.FaultSpec]:
     ]
 
 
+def _fire_event(ev: ScheduledEvent, t0: float) -> None:
+    delay = t0 + ev.offset_s - time.perf_counter()
+    if delay > 0:
+        time.sleep(delay)
+    with ev._lock:
+        if ev.cancelled:
+            return
+        ev.fired_s = time.perf_counter() - t0
+    try:
+        ev.fn()
+    except Exception as exc:  # noqa: BLE001 — the outcome is the datum
+        ev.error = repr(exc)
+
+
 def run_open_loop(
     handle,
     requests: Sequence[LoadRequest],
     arrival_offsets: Sequence[float],
     timeout_s: float = 60.0,
     settle_timeout_s: float = 120.0,
+    events: Sequence[ScheduledEvent] = (),
+    stream_resume_fn: Optional[Callable] = None,
+    record_tokens: bool = False,
 ) -> LoadRunResult:
     """Fire `requests[i]` at `arrival_offsets[i]` seconds from run start
     against `handle` (a Serve deployment handle for an LLMIngress app)
@@ -178,7 +248,13 @@ def run_open_loop(
     response; after the last arrival it waits up to `settle_timeout_s`
     for in-flight requests to settle (stragglers are recorded with
     error="ClientSettleTimeout" — the run result stays complete even
-    when the server collapsed under the offered load)."""
+    when the server collapsed under the offered load).
+
+    `events` are ScheduledEvents fired at their own offsets on timer
+    threads — the chaos-gating hook (e.g. a mid-run scale-down whose
+    drained streams must migrate with zero drops). `stream_resume_fn`
+    and `record_tokens` thread through to each consumer (see
+    _drive_one)."""
     if len(requests) != len(arrival_offsets):
         raise ValueError(
             f"{len(requests)} requests but {len(arrival_offsets)} arrivals"
@@ -196,8 +272,19 @@ def run_open_loop(
     ]
     poisons = arm_poison_faults(requests)
     threads: List[threading.Thread] = []
+    event_threads: List[threading.Thread] = []
+    events = list(events)
     t0 = time.perf_counter()
     try:
+        for ev in events:
+            th = threading.Thread(
+                target=_fire_event,
+                args=(ev, t0),
+                name=f"loadgen-event-{ev.name}",
+                daemon=True,
+            )
+            th.start()
+            event_threads.append(th)
         for i in order:
             delay = t0 + arrival_offsets[i] - time.perf_counter()
             if delay > 0:
@@ -205,17 +292,31 @@ def run_open_loop(
             th = threading.Thread(
                 target=_drive_one,
                 args=(handle, requests[i], samples[i], t0, timeout_s),
+                kwargs={
+                    "stream_resume_fn": stream_resume_fn,
+                    "record_tokens": record_tokens,
+                },
                 name=f"loadgen-{requests[i].request_id}",
                 daemon=True,
             )
             th.start()
             threads.append(th)
         deadline = time.monotonic() + settle_timeout_s
-        for th in threads:
+        for th in threads + event_threads:
             th.join(timeout=max(deadline - time.monotonic(), 0.0))
         for i, th in zip(order, threads):
             if th.is_alive() and samples[i].error is None:
                 samples[i].error = "ClientSettleTimeout"
+        for ev, th in zip(events, event_threads):
+            if th.is_alive():
+                # Settle window closed before the offset: stand the timer
+                # down so it can't fire against post-run serve state (or
+                # mutate this result after it's been serialized). Under
+                # the event lock: if the timer already passed its check,
+                # fired_s is set and the event stays un-cancelled.
+                with ev._lock:
+                    if ev.fired_s is None:
+                        ev.cancelled = True
     finally:
         for spec in poisons:
             fi.remove(spec)
@@ -226,4 +327,5 @@ def run_open_loop(
         offered_duration_s=offered_duration,
         wall_duration_s=wall,
         offered_rate=len(requests) / max(offered_duration, 1e-9),
+        events=events,
     )

@@ -233,6 +233,192 @@ def run_cell(
         serve.shutdown()
 
 
+def run_drain_cell(
+    rate: float,
+    num_requests: int,
+    seed: int,
+    timeout_s: float = 30.0,
+) -> dict:
+    """The autoscaling/drain robustness cell: two ingress replicas over
+    one shared engine, a scale-down to 1 fired MID-RUN under open-loop
+    multiturn traffic (streams carry llm_stream_resume, so anything the
+    drained replica can't finish migrates to the survivor). The gate
+    asserts zero dropped requests, the KV + draft pools back at boot
+    size, and exactly one replica taken DRAINING → STOPPED — the
+    serving-robustness claim, re-proved on every bench run.
+
+    The engine-histogram cross-check is deliberately NOT run here: a
+    migrated stream is a second engine-side request, so engine
+    percentiles legitimately disagree with client samples."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.config import EngineConfig
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+    from ray_tpu.loadgen import report as report_mod
+    from ray_tpu.loadgen.arrivals import ArrivalSpec, arrival_times
+    from ray_tpu.loadgen.driver import ScheduledEvent, run_open_loop
+    from ray_tpu.loadgen.scenarios import ScenarioSpec, generate_requests
+    from ray_tpu.loadgen.slo import IMPOSSIBLE_SLO, LOOSE_SLO, evaluate_slo
+
+    ecfg = EngineConfig(**BASE_ENGINE)
+    engine_name = f"loadgen-drain-r{rate:g}-s{seed}"
+    app_name = f"lg-drain-r{rate:g}"
+    handle = serve.run(
+        build_app(
+            serve_model_config(),
+            ecfg,
+            engine_name=engine_name,
+            num_replicas=2,
+            max_concurrent_queries=64,
+            graceful_shutdown_timeout_s=0.5,
+        ),
+        name=app_name,
+        _blocking_timeout_s=300.0,
+    )
+    try:
+        handle.remote(
+            {"prompt_ids": [1, 2, 3], "max_new_tokens": 2}
+        ).result(timeout_s=300.0)
+
+        spec = ScenarioSpec.for_engine(
+            ecfg.max_model_len,
+            ecfg.buckets()[-1],
+            vocab_size=128,
+            name="multiturn",
+            num_requests=num_requests,
+            seed=seed,
+        )
+        requests = generate_requests(spec)
+        offsets = arrival_times(
+            ArrivalSpec(process="uniform", rate=rate, seed=seed),
+            len(requests),
+        )
+        scale_event = ScheduledEvent(
+            offset_s=offsets[len(offsets) // 2],
+            name="scale_down_2_to_1",
+            fn=lambda: serve.scale_deployment(
+                "LLMIngress", 1, app_name=app_name
+            ),
+        )
+        result = run_open_loop(
+            handle,
+            requests,
+            offsets,
+            timeout_s=timeout_s,
+            settle_timeout_s=max(timeout_s * 2, 60.0),
+            events=[scale_event],
+            stream_resume_fn=llm_stream_resume,
+        )
+        stats = _drain_engine(handle)
+        drain_state = _await_drain_settled(app_name)
+
+        rep = report_mod.build_report(result)
+        verdicts = {
+            s.name: evaluate_slo(s, rep)
+            for s in (LOOSE_SLO, IMPOSSIBLE_SLO)
+        }
+        return {
+            "config": "drain_scale_down",
+            "knobs": {"num_replicas": "2->1 mid-run"},
+            "cpu_parity_only": False,
+            "rate": rate,
+            "report": rep,
+            "slo": verdicts,
+            "event": scale_event.to_dict(),
+            "drain": drain_state,
+            "engine": {
+                "wedged": stats.get("wedged"),
+                "dead_letters": stats.get("num_dead_letters"),
+                "kv_pool_allocated": stats.get("kv_pool_allocated"),
+                "spec_draft_pool_allocated": stats.get(
+                    "spec_draft_pool_allocated"
+                ),
+                "prefix_cache_hit_rate": stats.get("prefix_cache_hit_rate"),
+            },
+        }
+    finally:
+        try:
+            eng = ray_tpu.get_actor(f"llm_engine:{engine_name}")
+            ray_tpu.kill(eng)
+        except Exception:
+            pass  # engine never came up / already gone
+        serve.shutdown()
+
+
+def _await_drain_settled(
+    app_name: str, timeout_s: float = 30.0
+) -> dict:
+    """Poll the controller until no replica is DRAINING, then return the
+    deployment's lifecycle summary (state counts, drain totals, history
+    tail) for the cell record."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    controller = get_or_create_controller()
+    deadline = _time.monotonic() + timeout_s
+    dep: dict = {}
+    while _time.monotonic() < deadline:
+        obs = ray_tpu.get(controller.get_observability.remote(), timeout=10.0)
+        dep = obs.get(app_name, {}).get("LLMIngress", {})
+        counts = dep.get("state_counts", {})
+        if counts.get("DRAINING", 0) == 0 and dep.get(
+            "num_drained_replicas", 0
+        ) >= 1:
+            break
+        _time.sleep(0.1)
+    return {
+        "state_counts": dep.get("state_counts"),
+        "num_drained_replicas": dep.get("num_drained_replicas"),
+        "num_migrated_requests": dep.get("num_migrated_requests"),
+        "history": dep.get("history", [])[-10:],
+    }
+
+
+def _gate_drain(cell: dict) -> List[str]:
+    """Hard assertions for the drain cell: the scale event fired, zero
+    requests dropped (every sample completed — multiturn has no poisons
+    or disconnects), the SLO gate pair still discriminates, the KV +
+    draft pools drained to boot size, and exactly one replica went
+    through DRAINING → STOPPED leaving one RUNNING."""
+    tag = f"{cell['config']}@{cell['rate']}"
+    problems = []
+    if cell["event"].get("error") or cell["event"].get("fired_s") is None:
+        problems.append(f"{tag}: scale-down event failed: {cell['event']}")
+    if cell["report"]["num_errors"] != 0:
+        problems.append(
+            f"{tag}: {cell['report']['num_errors']} dropped requests "
+            f"under scale-down ({cell['report']['errors']})"
+        )
+    if not cell["slo"]["loose"]["passed"]:
+        problems.append(f"{tag}: loose SLO failed")
+    if cell["slo"]["impossible"]["passed"]:
+        problems.append(f"{tag}: impossible SLO passed")
+    if cell["engine"].get("kv_pool_allocated") not in (0, None):
+        problems.append(
+            f"{tag}: KV pool did not drain "
+            f"(allocated={cell['engine']['kv_pool_allocated']})"
+        )
+    if cell["engine"].get("spec_draft_pool_allocated") not in (0, None):
+        problems.append(f"{tag}: draft mirror pool did not drain")
+    if cell["engine"].get("wedged"):
+        problems.append(f"{tag}: engine wedged under scale-down")
+    drain = cell.get("drain") or {}
+    if drain.get("num_drained_replicas") != 1:
+        problems.append(
+            f"{tag}: expected exactly 1 drained replica, got "
+            f"{drain.get('num_drained_replicas')}"
+        )
+    counts = drain.get("state_counts") or {}
+    if counts.get("RUNNING") != 1 or counts.get("DRAINING", 0) != 0:
+        problems.append(
+            f"{tag}: post-drain replica states {counts} "
+            "(want 1 RUNNING, 0 DRAINING)"
+        )
+    return problems
+
+
 def _gate(cell: dict) -> List[str]:
     """The per-cell hard assertions every sweep run re-proves: the SLO
     gate must discriminate (loose passes, impossible fails), loadgen and
@@ -326,6 +512,23 @@ def run_sweep(
                 f"errors {rep['num_errors']}"
                 + (f"  !! {cell_problems}" if cell_problems else "")
             )
+    # The robustness cell: a chaos-gated scale-down under live traffic
+    # rides every sweep (quick included), so a drain regression can never
+    # ship behind a green perf record.
+    drain_cell = run_drain_cell(
+        rates[0], max(num_requests // 2, 12), seed
+    )
+    cells.append(drain_cell)
+    drain_problems = _gate_drain(drain_cell)
+    problems.extend(drain_problems)
+    print(
+        f"[{record_name}] drain_scale_down @ {rates[0]:g}/s: "
+        f"errors {drain_cell['report']['num_errors']}, "
+        f"drained {drain_cell['drain'].get('num_drained_replicas')} "
+        f"replica(s), migrated "
+        f"{drain_cell['drain'].get('num_migrated_requests')} stream(s)"
+        + (f"  !! {drain_problems}" if drain_problems else "")
+    )
     scenario = _build_scenario(num_requests, seed)
     record = {
         "record": record_name,
@@ -335,7 +538,10 @@ def run_sweep(
             "Open-loop driven through serve.build_app (router -> "
             "LLMIngress replica -> shared engine actor). CPU rows with "
             "cpu_parity_only=true run the pallas kernel in interpret "
-            "mode: parity exercise only, never a speedup claim."
+            "mode: parity exercise only, never a speedup claim. The "
+            "drain_scale_down cell fires a mid-run scale-down and gates "
+            "on zero dropped requests + pools drained + exactly one "
+            "replica DRAINING -> STOPPED."
         ),
         "engine_base": dict(BASE_ENGINE),
         "scenario": scenario.to_dict(),

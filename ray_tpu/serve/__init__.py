@@ -22,12 +22,17 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     run,
+    scale_deployment,
     shutdown,
     start,
     status,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.config import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    LLMAutoscalingPolicy,
+)
 from ray_tpu.serve.handle import (
     DeploymentHandle,
     DeploymentResponse,
@@ -44,6 +49,7 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "LLMAutoscalingPolicy",
     "batch",
     "deployment",
     "get_app_handle",
@@ -51,6 +57,7 @@ __all__ = [
     "get_multiplexed_model_id",
     "multiplexed",
     "run",
+    "scale_deployment",
     "schema",
     "shutdown",
     "start",

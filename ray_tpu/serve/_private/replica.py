@@ -15,6 +15,7 @@ import time
 from typing import Any
 
 from ray_tpu._private.fault_injection import maybe_fail
+from ray_tpu.exceptions import ReplicaDrainingError
 from ray_tpu.util import tracing
 
 
@@ -23,6 +24,15 @@ class ReplicaActor:
 
     Created by the controller with the user class/function (cloudpickled via
     normal actor-arg serialization), init args, and user_config.
+
+    Drain protocol (controller scale-down): `drain(timeout_s)` marks the
+    replica DRAINING — new dispatches are refused with the retryable
+    ReplicaDrainingError (the router re-dispatches them; the routing set
+    already shrank via the long-poll bump, so only racing dispatches hit
+    this), in-flight requests keep running, and streams still unfinished
+    at the drain deadline are interrupted with the same typed error so the
+    router stream-resumes them on surviving replicas instead of waiting
+    for the kill's ActorDiedError.
     """
 
     def __init__(
@@ -33,12 +43,21 @@ class ReplicaActor:
         init_args: tuple,
         init_kwargs: dict,
         user_config: Any = None,
+        collect_autoscaling_metrics: bool = False,
     ):
         self._deployment_name = deployment_name
         self._replica_tag = replica_tag
         self._lock = threading.Lock()
         self._num_ongoing = 0
         self._num_processed = 0
+        # Set by the controller for deployments under an SLO autoscaling
+        # policy: get_metrics then also collects the callable's
+        # autoscaling_metrics() — deployments that don't autoscale on SLO
+        # signals never pay the hook's cost.
+        self._collect_autoscaling = bool(collect_autoscaling_metrics)
+        self._draining = False
+        self._drain_deadline: float = 0.0  # monotonic; valid iff draining
+        self._num_drain_interrupted = 0
         # Monotonic: uptime_s is a duration, and wall-clock steps would
         # make it jump (or go negative) in the metrics.
         self._start_time = time.monotonic()
@@ -69,6 +88,59 @@ class ReplicaActor:
         if inspect.iscoroutine(result):
             asyncio.run(result)
 
+    # ---------------- drain protocol ----------------
+
+    def drain(self, timeout_s: float) -> bool:
+        """Controller scale-down hook: refuse new work, give in-flight
+        requests up to `timeout_s` to finish, interrupt streams after
+        (chaos site: replica.drain). Idempotent; returns True."""
+        maybe_fail(
+            "replica.drain",
+            detail=f"{self._deployment_name}:{self._replica_tag}",
+        )
+        with self._lock:
+            self._draining = True
+            self._drain_deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        return True
+
+    def _reject_if_draining(self) -> None:
+        with self._lock:
+            draining = self._draining
+        if draining:
+            raise ReplicaDrainingError(
+                f"replica {self._replica_tag} of {self._deployment_name} is "
+                "draining; re-dispatch to a surviving replica"
+            )
+
+    def _drain_interrupt_due(self) -> bool:
+        with self._lock:
+            return (
+                self._draining
+                and time.monotonic() >= self._drain_deadline
+            )
+
+    def _drain_interrupt(self, user_gen: Any) -> "ReplicaDrainingError":
+        """Account one stream interrupted at the drain deadline and close
+        the user generator FIRST, so its finally-cleanup (e.g. the LLM
+        ingress's engine abort, which frees the request's KV and
+        draft-mirror blocks) runs before the client's resume re-submits
+        the suffix elsewhere. Returns the error to raise."""
+        with self._lock:
+            self._num_drain_interrupted += 1
+        close = getattr(user_gen, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass  # cleanup best-effort; the kill path would be worse
+        return ReplicaDrainingError(
+            f"replica {self._replica_tag} of {self._deployment_name} "
+            "interrupted this stream at its drain deadline; resume on a "
+            "surviving replica"
+        )
+
+    # ---------------- request paths ----------------
+
     def handle_request(
         self,
         method_name: str,
@@ -82,6 +154,7 @@ class ReplicaActor:
             "replica.handle_request",
             detail=f"{self._deployment_name}:{self._replica_tag}:{method_name}",
         )
+        self._reject_if_draining()
         with self._lock:
             self._num_ongoing += 1
         token = _set_multiplexed_model_id(multiplexed_model_id)
@@ -130,6 +203,7 @@ class ReplicaActor:
             "replica.handle_request_streaming",
             detail=f"{self._deployment_name}:{self._replica_tag}:{method_name}",
         )
+        self._reject_if_draining()
         with self._lock:
             self._num_ongoing += 1
         token = _set_multiplexed_model_id(multiplexed_model_id)
@@ -153,6 +227,12 @@ class ReplicaActor:
                 loop = asyncio.new_event_loop()
                 try:
                     while True:
+                        if self._drain_interrupt_due():
+                            try:
+                                loop.run_until_complete(result.aclose())
+                            except Exception:
+                                pass
+                            raise self._drain_interrupt(None)
                         try:
                             item = loop.run_until_complete(result.__anext__())
                         except StopAsyncIteration:
@@ -168,7 +248,19 @@ class ReplicaActor:
                 n_items = 1
                 yield result  # non-iterable: a one-item stream
                 return
-            for item in result:
+            it = iter(result)
+            while True:
+                # A drain deadline interrupts BETWEEN items: delivered
+                # tokens stay delivered (the resume folds them into the
+                # re-submission), the user generator's cleanup runs here
+                # — not at some later GC — and the raised typed error is
+                # what the router migrates on.
+                if self._drain_interrupt_due():
+                    raise self._drain_interrupt(result)
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
                 # Chaos hook: die mid-stream after a deterministic number of
                 # items (simulates a replica lost between yields).
                 maybe_fail(
@@ -199,12 +291,31 @@ class ReplicaActor:
 
     def get_metrics(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "replica_tag": self._replica_tag,
                 "num_ongoing_requests": self._num_ongoing,
                 "num_processed": self._num_processed,
+                "draining": self._draining,
+                "num_drain_interrupted": self._num_drain_interrupted,
                 "uptime_s": time.monotonic() - self._start_time,
             }
+        # Autoscaling hook: a callable exposing autoscaling_metrics()
+        # (e.g. LLMIngress forwarding the engine's SLO histogram windows)
+        # rides the controller's existing metrics poll — one RPC, no
+        # second polling plane. Failures never fail the poll.
+        fn = (
+            getattr(self._callable, "autoscaling_metrics", None)
+            if self._collect_autoscaling
+            else None
+        )
+        if fn is not None:
+            try:
+                snap = fn()
+                if isinstance(snap, dict) and snap:
+                    out["autoscaling"] = snap
+            except Exception:
+                pass
+        return out
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

@@ -61,6 +61,7 @@ class Deployment:
         graceful_shutdown_timeout_s: Optional[float] = None,
         request_retry_budget: Optional[int] = None,
         request_backoff_initial_s: Optional[float] = None,
+        stream_resume_fn: Optional[Callable] = None,
     ) -> "Deployment":
         cfg = replace(self._config)
         if num_replicas is not None:
@@ -83,6 +84,8 @@ class Deployment:
             cfg.request_retry_budget = request_retry_budget
         if request_backoff_initial_s is not None:
             cfg.request_backoff_initial_s = request_backoff_initial_s
+        if stream_resume_fn is not None:
+            cfg.stream_resume_fn = stream_resume_fn
         return Deployment(self._callable_def, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -187,6 +190,7 @@ def run(
         ingress._config.max_concurrent_queries,
         retry_budget=ingress._config.request_retry_budget,
         backoff_initial_s=ingress._config.request_backoff_initial_s,
+        stream_resume_fn=ingress._config.stream_resume_fn,
     )
 
 
@@ -206,6 +210,31 @@ def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
             raise RuntimeError(f"Deployment failed: {bad}")
         time.sleep(0.05)
     raise TimeoutError(f"Application {app_name!r} not healthy in {timeout_s}s")
+
+
+def scale_deployment(
+    deployment_name: str, num_replicas: int, app_name: str = _DEFAULT_APP
+) -> None:
+    """Imperatively retarget a deployment's replica count (ops / chaos
+    hook — the loadgen drain cell fires this mid-run). Scale-down goes
+    through the controller's graceful drain protocol: the shrunk routing
+    set publishes first, in-flight requests get up to
+    graceful_shutdown_timeout_s to finish, and interrupted streams
+    resume on surviving replicas. A deployment with an autoscaling
+    policy keeps autoscaling — the policy overrides this target on the
+    next reconcile pass."""
+    from ray_tpu import api as ray
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    ok = ray.get(
+        get_or_create_controller().set_target_replicas.remote(
+            app_name, deployment_name, int(num_replicas)
+        )
+    )
+    if not ok:
+        raise ValueError(
+            f"No deployment {deployment_name!r} in app {app_name!r}"
+        )
 
 
 def get_deployment_handle(
@@ -252,6 +281,10 @@ def _handle_with_configured_knobs(
         cfg.max_concurrent_queries,
         retry_budget=cfg.request_retry_budget,
         backoff_initial_s=cfg.request_backoff_initial_s,
+        # The deployment-declared mid-stream failover policy rides every
+        # configured handle — including the HTTP proxy's — so streams
+        # migrate off dying/draining replicas for HTTP clients too.
+        stream_resume_fn=getattr(cfg, "stream_resume_fn", None),
     )
 
 

@@ -31,6 +31,7 @@ from ray_tpu._private.runtime import get_runtime
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
+    ReplicaDrainingError,
     ReplicaUnavailableRetryExhausted,
 )
 
@@ -47,6 +48,10 @@ RETRYABLE_ERRORS = (ActorDiedError, ActorUnavailableError)
 
 BACKOFF_MULTIPLIER = 2.0
 BACKOFF_MAX_S = 2.0
+# Planned drain migrations don't consume the retry budget (rolling drains
+# could legitimately move one long stream several times), but they are
+# capped so a pathological all-replicas-draining loop still terminates.
+DRAIN_RETRY_CAP = 32
 
 
 class _RequestContext:
@@ -61,6 +66,7 @@ class _RequestContext:
         "model_id",
         "excluded",
         "failures",
+        "drains",
         "tag",
     )
 
@@ -71,6 +77,7 @@ class _RequestContext:
         self.model_id = model_id
         self.excluded: set[str] = set()
         self.failures = 0
+        self.drains = 0  # planned drain migrations (budget-exempt)
         self.tag: Optional[str] = None  # replica serving the latest attempt
 
 
@@ -210,7 +217,14 @@ class DeploymentResponseGenerator:
             # Items were already delivered and there is no way to re-submit
             # just the suffix: replaying from scratch would duplicate them.
             raise exc
-        if self._resume_fn is not None and self._items:
+        if self._resume_fn is not None:
+            # Consulted even with ZERO delivered items: a stream that died
+            # (or was drain-interrupted) before its first item may already
+            # have state server-side — for LLM requests the original
+            # engine request can still be draining under the caller's
+            # pinned request_id, so a verbatim re-dispatch would collide
+            # with it (llm_stream_resume re-keys the re-submission; the
+            # orphan's abort races free of the retry).
             resumed = self._resume_fn(
                 self._ctx.args, self._ctx.kwargs, list(self._items)
             )
@@ -343,6 +357,13 @@ class Router:
             "(ReplicaUnavailableRetryExhausted)",
             tag_keys=("deployment",),
         )
+        self._m_drain_migrations = get_or_create(
+            Counter,
+            "serve_router_drain_migrations",
+            "Requests re-dispatched (or streams resumed) off a DRAINING "
+            "replica — planned migrations, exempt from the retry budget",
+            tag_keys=("deployment",),
+        )
         self._lock = threading.Condition()
         self._replicas: dict[str, Any] = {}
         self._in_flight: dict[str, int] = {}
@@ -472,10 +493,20 @@ class Router:
     def plan_retry(self, ctx: _RequestContext, exc: BaseException) -> float:
         """Account one failed dispatch attempt: exclude the replica it
         landed on and compute the exponential backoff delay. Raises the
-        typed ReplicaUnavailableRetryExhausted once the budget is spent."""
+        typed ReplicaUnavailableRetryExhausted once the budget is spent.
+
+        A ReplicaDrainingError is a PLANNED migration, not a failure: the
+        draining replica is excluded and the request re-dispatched after
+        one short backoff (enough for the long-poll refresh of the shrunk
+        replica set to land), without consuming the retry budget a real
+        replica death may still need."""
         if ctx.tag is not None and ctx.tag not in ctx.excluded:
             ctx.excluded.add(ctx.tag)
             self._m_excluded.inc(tags=self._dep_tags)
+        if isinstance(exc, ReplicaDrainingError) and ctx.drains < DRAIN_RETRY_CAP:
+            ctx.drains += 1
+            self._m_drain_migrations.inc(tags=self._dep_tags)
+            return self._backoff_initial_s
         ctx.failures += 1
         if ctx.failures > self._retry_budget:
             self._m_exhausted.inc(tags=self._dep_tags)
@@ -592,8 +623,13 @@ class Router:
                                     self._in_flight.get(tag, 0) + 1
                                 )
                                 return tag, h
-                    if len(candidates) > 2:
-                        candidates = random.sample(candidates, 2)
+                    # Random sample doubles as a random TIE-BREAK: with a
+                    # deterministic order, N fresh routers (all counts 0)
+                    # would all pick the same first replica and pile a
+                    # whole burst onto it.
+                    candidates = random.sample(
+                        candidates, min(len(candidates), 2)
+                    )
                     tag, h = min(
                         candidates, key=lambda th: self._in_flight.get(th[0], 0)
                     )
@@ -617,6 +653,23 @@ class Router:
         self._closed = True
 
 
+class _RouterCell:
+    """Shared lazy slot for one Router, held by every handle derived from
+    the same root with unchanged retry knobs. Without it, each
+    `handle.options(...)` on a handle whose router was not yet created
+    built its OWN router on first use — N concurrent streams from fresh
+    per-request handles then carried N independent in-flight tables
+    (and N poll threads), and the power-of-two choice degenerated to
+    "everyone's counts are zero, everyone picks the same first replica":
+    a whole burst piled onto one replica of a balanced pair."""
+
+    __slots__ = ("router", "lock")
+
+    def __init__(self, router: Optional[Router] = None):
+        self.router = router
+        self.lock = threading.Lock()
+
+
 class DeploymentHandle:
     """User-facing handle: `handle.remote(...)` / `handle.method.remote(...)`
     (reference: serve/handle.py:74)."""
@@ -633,6 +686,7 @@ class DeploymentHandle:
         retry_budget: Optional[int] = None,
         backoff_initial_s: Optional[float] = None,
         stream_resume_fn: Optional[Callable] = None,
+        _router_cell: Optional[_RouterCell] = None,
     ):
         self._app = app
         self._deployment = deployment
@@ -640,21 +694,31 @@ class DeploymentHandle:
         self._method_name = method_name
         self._model_id = multiplexed_model_id
         self._stream = stream
-        self._router = _router
+        self._router_cell = _router_cell or _RouterCell(_router)
         self._retry_budget = retry_budget
         self._backoff_initial_s = backoff_initial_s
         self._stream_resume_fn = stream_resume_fn
 
+    @property
+    def _router(self) -> Optional[Router]:
+        return self._router_cell.router
+
     def _get_router(self) -> Router:
-        if self._router is None:
-            self._router = Router(
-                self._app,
-                self._deployment,
-                self._max_q,
-                retry_budget=self._retry_budget,
-                backoff_initial_s=self._backoff_initial_s,
-            )
-        return self._router
+        cell = self._router_cell
+        if cell.router is None:
+            # Double-checked under the cell lock: concurrent first
+            # requests (the loadgen open-loop burst) must share ONE
+            # router, not race N into existence.
+            with cell.lock:
+                if cell.router is None:
+                    cell.router = Router(
+                        self._app,
+                        self._deployment,
+                        self._max_q,
+                        retry_budget=self._retry_budget,
+                        backoff_initial_s=self._backoff_initial_s,
+                    )
+        return cell.router
 
     def remote(self, *args, **kwargs):
         return self._get_router().assign(
@@ -683,9 +747,12 @@ class DeploymentHandle:
             if multiplexed_model_id is not None
             else self._model_id,
             stream if stream is not None else self._stream,
-            # Retry knobs live on the Router, so a shared router can't be
-            # reused when they change.
-            _router=None if changed_router_cfg else self._router,
+            # Retry knobs live on the Router, so a shared router (cell)
+            # can't be reused when they change. The CELL is shared — not
+            # just an already-built router — so per-request options()
+            # handles converge on one router even when the first of them
+            # races the root's lazy creation.
+            _router_cell=None if changed_router_cfg else self._router_cell,
             retry_budget=retry_budget
             if retry_budget is not None
             else self._retry_budget,

@@ -165,6 +165,24 @@ class Histogram(Metric):
                 for key in self._buckets
             }
 
+    def snapshot(self, tags: Optional[dict] = None) -> dict:
+        """Bucket counts / sum / count for ONE series of this histogram
+        (zeros when the series has not observed yet). Instance-level
+        sibling of the registry-keyed `histogram_snapshot` below — holders
+        of the metric object (e.g. the LLM engine shipping its SLO
+        histogram windows to the autoscaler) snapshot without a registry
+        lookup, so a test's reset_registry can never make them miss."""
+        key = self._merged(tags)
+        with self._lock:
+            return {
+                "boundaries": list(self.boundaries),
+                "buckets": list(
+                    self._buckets.get(key, [0] * (len(self.boundaries) + 1))
+                ),
+                "sum": self._sums.get(key, 0.0),
+                "count": self._counts.get(key, 0),
+            }
+
 
 def _escape_label(value: str) -> str:
     """Prometheus exposition label escaping: backslash, quote, newline."""
@@ -260,17 +278,7 @@ def histogram_snapshot(name: str, tags: Optional[dict] = None) -> dict:
         raise KeyError(f"no metric named {name!r} is registered")
     if not isinstance(m, Histogram):
         raise TypeError(f"metric {name!r} is a {m.kind}, not a histogram")
-    key = m._merged(tags)
-    with m._lock:
-        buckets = list(
-            m._buckets.get(key, [0] * (len(m.boundaries) + 1))
-        )
-        return {
-            "boundaries": list(m.boundaries),
-            "buckets": buckets,
-            "sum": m._sums.get(key, 0.0),
-            "count": m._counts.get(key, 0),
-        }
+    return m.snapshot(tags)
 
 
 def histogram_percentile(

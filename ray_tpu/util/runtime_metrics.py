@@ -317,6 +317,66 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             continue
 
 
+def sample_serve_metrics(runtime, timeout_s: float = 2.0) -> None:
+    """Scrape-time freshness for the Serve control-plane gauges: replica
+    lifecycle-state counts per deployment
+    (serve_deployment_replica_state{app,deployment,state}) from the
+    controller's observability snapshot. Every known state is written on
+    every scrape — including zeros — so a state that empties (the last
+    DRAINING replica stopping) never freezes at its final nonzero value.
+    Failures are swallowed: a busy controller must never break /metrics."""
+    from ray_tpu.serve._private.controller import (
+        CONTROLLER_NAME,
+        REPLICA_STATES,
+    )
+    from ray_tpu.util.metrics import get_or_create
+
+    existing = runtime.controller.get_named_actor(
+        CONTROLLER_NAME, runtime.namespace
+    )
+    if existing is None:
+        return
+    import ray_tpu
+    from ray_tpu.actor import ActorHandle
+
+    try:
+        obs = ray_tpu.get(
+            ActorHandle(
+                existing, "ServeControllerActor"
+            ).get_observability.remote(),
+            timeout=timeout_s,
+        )
+    except Exception:
+        return
+    state_gauge = get_or_create(
+        Gauge,
+        "serve_deployment_replica_state",
+        "Replicas per lifecycle state (STARTING/RUNNING/DRAINING; STOPPED "
+        "replicas leave the set, so its series reads 0)",
+        tag_keys=("app", "deployment", "state"),
+    )
+    seen = set()
+    for app_name, deps in obs.items():
+        for dep_name, dep in deps.items():
+            counts = dep.get("state_counts", {})
+            for state in REPLICA_STATES:
+                tags = {
+                    "app": app_name, "deployment": dep_name, "state": state,
+                }
+                state_gauge.set(float(counts.get(state, 0)), tags=tags)
+                seen.add((app_name, dep_name, state))
+    # Deployments deleted since the last scrape: zero their series so the
+    # history chart doesn't carry ghost replicas.
+    for tags, _old in state_gauge._series().items():
+        td = dict(tags)
+        key = (td.get("app"), td.get("deployment"), td.get("state"))
+        if all(key) and key not in seen:
+            state_gauge.set(
+                0.0,
+                tags={"app": key[0], "deployment": key[1], "state": key[2]},
+            )
+
+
 class RuntimeMetricsSampler:
     """Background refresher (the reporter-agent analog)."""
 

@@ -1,0 +1,676 @@
+"""Chaos tests for the replica-lifecycle + autoscaling control plane.
+
+The drain protocol's contract, proved under deterministic fault injection
+and live open-loop traffic:
+
+  * a scale-down fired mid-run drops ZERO requests — the shrunk routing
+    set publishes before any stop, in-flight streams either finish within
+    graceful_shutdown_timeout_s or are interrupted with the typed
+    ReplicaDrainingError and stream-resumed onto surviving replicas, and
+    every migrated greedy stream is token-identical to an undisturbed run
+    (the resume re-submits prompt + tokens-so-far; prefix caching makes
+    the re-prefill cheap);
+  * a fault injected into the drain conversation itself
+    (controller.drain_replica / replica.drain) degrades to the plain
+    kill path — clients are covered by the PR 3 ActorDiedError failover,
+    still zero drops;
+  * an LLM deployment under LLMAutoscalingPolicy scales up on the
+    engine's windowed queue-time p99 while the loose SLO still passes,
+    and scales back down after the burst — both asserted from the
+    controller's replica-state history;
+  * the victim's engine-side footprint (KV + draft-mirror pools) is
+    reclaimed: pools back at boot size once the migrated streams finish.
+
+Every test seeds the model identically (seed=0), so greedy outputs have
+an exact unbatched ground truth to compare against.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import fault_injection as fi
+from ray_tpu.llm import EngineConfig, LLMEngine
+from ray_tpu.models.gpt import GPT, GPTConfig
+from ray_tpu.serve._private.controller import get_or_create_controller
+
+pytestmark = pytest.mark.chaos
+
+TINY = GPTConfig(
+    vocab_size=128,
+    num_layers=2,
+    num_heads=4,
+    embed_dim=64,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+
+ECFG_SERVE = EngineConfig(
+    block_size=8,
+    num_blocks=64,
+    max_decode_slots=8,
+    max_blocks_per_seq=8,
+    prefill_buckets=(8, 32),
+)
+
+# Per-token decode delay: slows streams enough that a drain deadline
+# reliably lands mid-stream on CPU, without changing a single token.
+DECODE_DELAY_S = 0.01
+
+
+def reference_greedy(model, params, prompt, n_tokens, pad_to=64):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        padded = np.zeros((1, pad_to), np.int32)
+        padded[0, : len(toks)] = toks
+        logits = model.apply(params, jnp.asarray(padded))
+        t = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def random_prompts(lengths, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=n))) for n in lengths]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+@pytest.fixture
+def serve_ray():
+    runtime = ray_tpu.init(
+        num_cpus=8,
+        _system_config={"include_dashboard": True, "dashboard_port": 0},
+    )
+    yield runtime
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _build_llm_app(engine_name, app_name, num_replicas=2, drain_timeout_s=0.15):
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app
+
+    return serve.run(
+        build_app(
+            TINY,
+            ECFG_SERVE,
+            engine_name=engine_name,
+            num_replicas=num_replicas,
+            graceful_shutdown_timeout_s=drain_timeout_s,
+        ),
+        name=app_name,
+    )
+
+
+def _await_history(app, deployment, predicate, timeout_s=20.0):
+    """Poll the controller's replica-state history until predicate(history)
+    is truthy; returns the final history."""
+    controller = get_or_create_controller()
+    deadline = time.monotonic() + timeout_s
+    hist = []
+    while time.monotonic() < deadline:
+        hist = ray_tpu.get(
+            controller.get_replica_state_history.remote(app, deployment)
+        )
+        if predicate(hist):
+            return hist
+        time.sleep(0.05)
+    return hist
+
+
+def _states_for(hist, tag):
+    return [h["state"] for h in hist if h["tag"] == tag]
+
+
+# ---------------- graceful drain under concurrent streams ----------------
+
+
+def test_drain_migrates_streams_token_identical_pools_reclaimed(serve_ray):
+    """Acceptance core: 6 concurrent greedy streams across 2 replicas; a
+    scale-down to 1 drains the victim mid-stream. Every stream completes
+    token-identical to the unbatched ground truth (zero drops, zero
+    duplicated/missing tokens across the migration seam), at least one
+    stream really was interrupted + migrated, the victim walks
+    DRAINING → STOPPED in the controller history, and the engine's KV +
+    draft pools are back at boot size."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+
+    handle = _build_llm_app("drain-mig", "llmdrain1")
+    n_new = 20
+    prompts = random_prompts((5, 6, 7, 8, 5, 6), seed=11)
+    model = GPT(TINY)
+    params = LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params
+    want = [reference_greedy(model, params, p, n_new) for p in prompts]
+
+    delay = fi.inject(
+        "llm.decode.seq", action="delay", delay_s=DECODE_DELAY_S,
+        every=1, times=None,
+    )
+    got = [None] * len(prompts)
+    errors = []
+
+    def consume(i):
+        try:
+            stream = handle.options(
+                stream=True, stream_resume_fn=llm_stream_resume
+            ).remote(
+                {"prompt_ids": prompts[i], "max_new_tokens": n_new,
+                 "stream": True}
+            )
+            got[i] = [d["token_id"] for d in stream]
+        except BaseException as exc:  # noqa: BLE001 — the drop IS the bug
+            errors.append((i, repr(exc)))
+
+    threads = [
+        threading.Thread(target=consume, args=(i,), daemon=True)
+        for i in range(len(prompts))
+    ]
+    try:
+        for t in threads:
+            t.start()
+        # Wait until streaming is really underway on both replicas (the
+        # power-of-two router splits 6 dispatches 3/3), then scale down.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            started = sum(1 for g in got if g is not None)
+            metrics = ray_tpu.get(
+                ray_tpu.get_actor("llm_engine:drain-mig").metrics.remote()
+            )
+            if metrics["num_running"] >= 4:
+                break
+            time.sleep(0.02)
+        serve.scale_deployment("LLMIngress", 1, app_name="llmdrain1")
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        fi.remove(delay)
+
+    assert errors == []  # zero dropped requests
+    for i, tokens in enumerate(got):
+        assert tokens == want[i], f"stream {i} diverged across the drain"
+
+    controller = get_or_create_controller()
+    hist = _await_history(
+        "llmdrain1",
+        "LLMIngress",
+        lambda h: any(x["state"] == "STOPPED" for x in h),
+    )
+    drained_tags = {
+        x["tag"] for x in hist if x["state"] == "DRAINING"
+    }
+    assert len(drained_tags) == 1  # exactly one victim
+    (victim,) = drained_tags
+    states = _states_for(hist, victim)
+    assert states[-1] == "STOPPED"
+    assert "DRAINING" in states
+    obs = ray_tpu.get(controller.get_observability.remote())
+    dep = obs["llmdrain1"]["LLMIngress"]
+    assert dep["state_counts"]["RUNNING"] == 1
+    assert dep["state_counts"]["DRAINING"] == 0
+    assert dep["num_drained_replicas"] == 1
+    # The victim held ~3 of 6 slow streams; the 0.15s deadline cannot have
+    # let 20-token streams finish — at least one was interrupted and
+    # migrated through the stream-resume path.
+    assert dep["num_migrated_requests"] >= 1
+
+    # Victim's engine-side footprint reclaimed: pools at boot size.
+    stats = ray_tpu.get(
+        ray_tpu.get_actor("llm_engine:drain-mig").metrics.remote()
+    )
+    assert stats["kv_pool_allocated"] == 0
+    assert stats["spec_draft_pool_allocated"] == 0
+    assert stats["wedged"] is False
+
+
+def test_drain_under_open_loop_traffic_token_identical_to_baseline(serve_ray):
+    """Loadgen-driven chaos gate: the SAME seeded open-loop multiturn
+    schedule runs twice — undisturbed, then with a scale-down event fired
+    mid-sweep. The chaos run must drop zero requests and deliver
+    token-identical streams per request id (record_tokens=True), with the
+    drain visible in the controller history."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+    from ray_tpu.loadgen import (
+        ArrivalSpec,
+        ScenarioSpec,
+        ScheduledEvent,
+        arrival_times,
+        generate_requests,
+        run_open_loop,
+    )
+
+    spec = ScenarioSpec.for_engine(
+        ECFG_SERVE.max_model_len,
+        ECFG_SERVE.buckets()[-1],
+        vocab_size=128,
+        name="multiturn",
+        num_requests=10,
+        seed=3,
+        max_new_tokens=10,
+    )
+    requests = generate_requests(spec)
+    offsets = arrival_times(
+        ArrivalSpec(process="uniform", rate=6.0, seed=3), len(requests)
+    )
+    delay = fi.inject(
+        "llm.decode.seq", action="delay", delay_s=0.005,
+        every=1, times=None,
+    )
+    try:
+        results = {}
+        for label, events in (
+            ("baseline", []),
+            (
+                "chaos",
+                [
+                    ScheduledEvent(
+                        offset_s=offsets[len(offsets) // 2],
+                        name="scale_down",
+                        fn=lambda: serve.scale_deployment(
+                            "LLMIngress", 1, app_name="lg-chaos"
+                        ),
+                    )
+                ],
+            ),
+        ):
+            handle = _build_llm_app(
+                f"lg-{label}", f"lg-{label}", drain_timeout_s=0.1
+            )
+            results[label] = run_open_loop(
+                handle,
+                requests,
+                offsets,
+                timeout_s=30.0,
+                settle_timeout_s=60.0,
+                events=events,
+                stream_resume_fn=llm_stream_resume,
+                record_tokens=True,
+            )
+    finally:
+        fi.remove(delay)
+
+    chaos = results["chaos"]
+    (event,) = chaos.events
+    assert event.error is None and event.fired_s is not None
+    for run in results.values():
+        assert all(s.error is None for s in run.samples), [
+            (s.request_id, s.error) for s in run.samples if s.error
+        ]
+    base_tokens = {
+        s.request_id: s.token_ids for s in results["baseline"].samples
+    }
+    for s in chaos.samples:
+        assert s.token_ids == base_tokens[s.request_id], (
+            f"{s.request_id} diverged under the mid-sweep scale-down"
+        )
+    hist = _await_history(
+        "lg-chaos",
+        "LLMIngress",
+        lambda h: any(x["state"] == "STOPPED" for x in h),
+    )
+    assert any(x["state"] == "DRAINING" for x in hist)
+    stats = ray_tpu.get(
+        ray_tpu.get_actor("llm_engine:lg-chaos").metrics.remote()
+    )
+    assert stats["kv_pool_allocated"] == 0
+    assert stats["spec_draft_pool_allocated"] == 0
+
+
+@pytest.mark.parametrize(
+    "site", ["controller.drain_replica", "replica.drain"]
+)
+def test_drain_fault_degrades_to_kill_failover_zero_drops(serve_ray, site):
+    """Chaos gating of the drain plane itself: a fault injected into the
+    drain conversation (controller side or replica side) must degrade to
+    the plain stop path — the victim is killed, its streams fail over via
+    the PR 3 ActorDiedError path, and the client still sees every token
+    exactly once."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import llm_stream_resume
+
+    suffix = site.split(".")[-1].replace("_", "")
+    engine = f"drainfault-{suffix}"
+    app = f"llmdrainfault-{suffix}"
+    handle = _build_llm_app(engine, app)
+    n_new = 16
+    prompts = random_prompts((5, 7, 6, 8), seed=23)
+    model = GPT(TINY)
+    params = LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params
+    want = [reference_greedy(model, params, p, n_new) for p in prompts]
+
+    delay = fi.inject(
+        "llm.decode.seq", action="delay", delay_s=DECODE_DELAY_S,
+        every=1, times=None,
+    )
+    fault = fi.inject(site, times=1)
+    got = [None] * len(prompts)
+    errors = []
+
+    def consume(i):
+        try:
+            stream = handle.options(
+                stream=True, stream_resume_fn=llm_stream_resume
+            ).remote(
+                {"prompt_ids": prompts[i], "max_new_tokens": n_new,
+                 "stream": True}
+            )
+            got[i] = [d["token_id"] for d in stream]
+        except BaseException as exc:  # noqa: BLE001
+            errors.append((i, repr(exc)))
+
+    threads = [
+        threading.Thread(target=consume, args=(i,), daemon=True)
+        for i in range(len(prompts))
+    ]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            metrics = ray_tpu.get(
+                ray_tpu.get_actor(f"llm_engine:{engine}").metrics.remote()
+            )
+            if metrics["num_running"] >= 3:
+                break
+            time.sleep(0.02)
+        serve.scale_deployment("LLMIngress", 1, app_name=app)
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        fi.remove(delay)
+        fi.remove(fault)
+
+    assert fault.fires == 1  # the drain conversation really failed
+    assert errors == []  # degradation still drops nothing
+    for i, tokens in enumerate(got):
+        assert tokens == want[i]
+    hist = _await_history(
+        app,
+        "LLMIngress",
+        lambda h: any(x["state"] == "STOPPED" for x in h),
+    )
+    assert any(x["state"] == "DRAINING" for x in hist)  # it tried
+    obs = ray_tpu.get(get_or_create_controller().get_observability.remote())
+    assert obs[app]["LLMIngress"]["state_counts"]["RUNNING"] == 1
+
+
+# ---------------- SLO-driven autoscaling ----------------
+
+
+def test_llm_autoscaling_ramp_scales_up_then_down(serve_ray):
+    """Acceptance: under a ramp arrival, an LLM deployment with
+    LLMAutoscalingPolicy scales up on the engine's windowed queue-time
+    p99 BEFORE the loose SLO fails, and scales back down after the burst
+    — both read from the controller's replica-state history."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+    from ray_tpu.loadgen import (
+        ArrivalSpec,
+        LOOSE_SLO,
+        ScenarioSpec,
+        arrival_times,
+        build_report,
+        evaluate_slo,
+        generate_requests,
+        run_open_loop,
+    )
+
+    policy = serve.LLMAutoscalingPolicy(
+        min_replicas=1,
+        max_replicas=2,
+        target_queue_time_p99_s=0.05,
+        look_back_period_s=1.0,
+        upscale_cooldown_s=0.2,
+        downscale_cooldown_s=0.3,
+    )
+    handle = serve.run(
+        build_app(
+            TINY,
+            ECFG_SERVE,
+            engine_name="autoscale",
+            autoscaling_config=policy,
+            graceful_shutdown_timeout_s=0.5,
+        ),
+        name="llmauto",
+    )
+    spec = ScenarioSpec.for_engine(
+        ECFG_SERVE.max_model_len,
+        ECFG_SERVE.buckets()[-1],
+        vocab_size=128,
+        name="multiturn",
+        num_requests=24,
+        seed=7,
+        max_new_tokens=8,
+    )
+    requests = generate_requests(spec)
+    offsets = arrival_times(
+        ArrivalSpec(process="ramp", rate=3.0, ramp_to_rate=24.0, seed=7),
+        len(requests),
+    )
+    # Saturate the 8 decode slots so admissions actually queue: the
+    # windowed queue-time p99 is the signal the policy scales on.
+    delay = fi.inject(
+        "llm.decode.seq", action="delay", delay_s=0.008,
+        every=1, times=None,
+    )
+    try:
+        result = run_open_loop(
+            handle,
+            requests,
+            offsets,
+            timeout_s=60.0,
+            settle_timeout_s=120.0,
+            stream_resume_fn=llm_stream_resume,
+        )
+    finally:
+        fi.remove(delay)
+
+    assert all(s.error is None for s in result.samples), [
+        (s.request_id, s.error) for s in result.samples if s.error
+    ]
+    # The burst still met the loose SLO — the fleet scaled before p99
+    # burned, not after the gate failed.
+    report = build_report(result)
+    assert evaluate_slo(LOOSE_SLO, report)["passed"] is True
+
+    # Scale-up during the ramp: a second replica reached RUNNING.
+    hist = _await_history(
+        "llmauto",
+        "LLMIngress",
+        lambda h: len(
+            {x["tag"] for x in h if x["state"] == "RUNNING"}
+        ) >= 2,
+        timeout_s=10.0,
+    )
+    running_tags = {x["tag"] for x in hist if x["state"] == "RUNNING"}
+    assert len(running_tags) >= 2, (
+        f"autoscaler never scaled up under the ramp: {hist}"
+    )
+    # Scale-down after the burst: the quiet look-back window drains one
+    # replica back out (DRAINING then STOPPED in the history).
+    hist = _await_history(
+        "llmauto",
+        "LLMIngress",
+        lambda h: any(x["state"] == "DRAINING" for x in h)
+        and any(x["state"] == "STOPPED" for x in h),
+        timeout_s=30.0,
+    )
+    assert any(x["state"] == "DRAINING" for x in hist)
+    assert any(x["state"] == "STOPPED" for x in hist)
+    obs = ray_tpu.get(get_or_create_controller().get_observability.remote())
+    dep = obs["llmauto"]["LLMIngress"]
+    assert dep["state_counts"]["RUNNING"] == 1
+    # The SLO signal plumbing is live end to end: the controller computed
+    # windowed signals from the engine's histogram snapshots.
+    assert dep["autoscaling_signals"] is not None
+    stats = ray_tpu.get(
+        ray_tpu.get_actor("llm_engine:autoscale").metrics.remote()
+    )
+    assert stats["kv_pool_allocated"] == 0
+
+
+# ---------------- observability surface ----------------
+
+
+def test_serve_panel_and_replica_state_metrics(serve_ray):
+    """/api/serve renders lifecycle states, drain totals and durations;
+    /metrics exports serve_deployment_replica_state gauges (refreshed at
+    scrape time) and the serve_replica_drain_seconds histogram."""
+    from ray_tpu import serve
+
+    runtime = serve_ray
+    base = runtime.dashboard.url
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), name="panel")
+    assert handle.remote(1).result(timeout_s=30) == 1
+    serve.scale_deployment("echo", 1, app_name="panel")
+    _await_history(
+        "panel", "echo", lambda h: any(x["state"] == "STOPPED" for x in h)
+    )
+
+    with urllib.request.urlopen(f"{base}/api/serve", timeout=10) as resp:
+        panel = json.loads(resp.read().decode())
+    dep = panel["panel"]["echo"]
+    assert dep["status"] == "HEALTHY"
+    assert dep["state_counts"]["RUNNING"] == 1
+    assert dep["state_counts"]["DRAINING"] == 0
+    assert dep["num_drained_replicas"] == 1
+    assert dep["drain_seconds"]["p50"] is not None
+    states = [h["state"] for h in dep["history"]]
+    assert "DRAINING" in states and "STOPPED" in states
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    m = re.search(
+        r'serve_deployment_replica_state{app="panel",deployment="echo",'
+        r'state="RUNNING"} (\d+\.?\d*)',
+        text,
+    )
+    assert m and float(m.group(1)) == 1.0
+    m = re.search(
+        r'serve_deployment_replica_state{app="panel",deployment="echo",'
+        r'state="DRAINING"} (\d+\.?\d*)',
+        text,
+    )
+    assert m and float(m.group(1)) == 0.0
+    m = re.search(
+        r'serve_replica_drain_seconds_count{app="panel",deployment="echo"}'
+        r' (\d+)',
+        text,
+    )
+    assert m and int(m.group(1)) == 1
+    # App-tagged: same-named deployments in different apps (every
+    # build_app ingress is "LLMIngress") keep separate drain series.
+    assert (
+        'serve_deployment_replicas_drained{app="panel",deployment="echo"} 1'
+        in text
+    )
+
+
+def test_http_streams_survive_drain_via_deployment_resume_policy(serve_ray):
+    """The deployment-declared stream-resume policy (DeploymentConfig
+    .stream_resume_fn, set by build_app) reaches handles built from config
+    — including the HTTP proxy's — so ndjson clients survive a mid-stream
+    drain token-identical without opting in per handle."""
+    import urllib.request as _url
+
+    from ray_tpu import serve
+    from ray_tpu.serve._private.http_proxy import start_proxy, stop_proxy
+
+    handle = _build_llm_app("http-drain", "httpdrain")
+    host, port = start_proxy("127.0.0.1", 0, 60.0)
+    n_new = 16
+    prompts = random_prompts((5, 7, 6, 8), seed=31)
+    model = GPT(TINY)
+    params = LLMEngine(TINY, ECFG_SERVE, seed=0).runner.params
+    want = [reference_greedy(model, params, p, n_new) for p in prompts]
+
+    delay = fi.inject(
+        "llm.decode.seq", action="delay", delay_s=DECODE_DELAY_S,
+        every=1, times=None,
+    )
+    got = [None] * len(prompts)
+    errors = []
+
+    def consume(i):
+        try:
+            req = _url.Request(
+                f"http://{host}:{port}/httpdrain?stream=1",
+                data=json.dumps(
+                    {"prompt_ids": prompts[i], "max_new_tokens": n_new,
+                     "stream": True}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            toks = []
+            with _url.urlopen(req, timeout=120) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        toks.append(json.loads(line)["result"]["token_id"])
+            got[i] = toks
+        except BaseException as exc:  # noqa: BLE001
+            errors.append((i, repr(exc)))
+
+    threads = [
+        threading.Thread(target=consume, args=(i,), daemon=True)
+        for i in range(len(prompts))
+    ]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            m = ray_tpu.get(
+                ray_tpu.get_actor("llm_engine:http-drain").metrics.remote()
+            )
+            if m["num_running"] >= 3:
+                break
+            time.sleep(0.02)
+        serve.scale_deployment("LLMIngress", 1, app_name="httpdrain")
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        fi.remove(delay)
+        stop_proxy()
+
+    assert errors == []  # HTTP clients dropped nothing
+    for i, tokens in enumerate(got):
+        assert tokens == want[i], f"HTTP stream {i} diverged across the drain"
+    hist = _await_history(
+        "httpdrain",
+        "LLMIngress",
+        lambda h: any(x["state"] == "STOPPED" for x in h),
+    )
+    assert any(x["state"] == "DRAINING" for x in hist)
+    stats = ray_tpu.get(
+        ray_tpu.get_actor("llm_engine:http-drain").metrics.remote()
+    )
+    assert stats["kv_pool_allocated"] == 0

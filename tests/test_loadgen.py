@@ -461,3 +461,78 @@ def test_loadgen_cli_report_roundtrip(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "base @ 4/s" in out
     assert "SLO loose: PASS" in out
+
+
+# ---------------- driver: scheduled events + token recording ----------------
+
+
+class _StubStreamHandle:
+    """Handle-shaped stub: every request streams three fixed token dicts.
+    Lets the event/token-recording plumbing be tested without a serve
+    stack."""
+
+    def __init__(self):
+        self.resume_fns = []
+
+    def options(self, **opts):
+        self.resume_fns.append(opts.get("stream_resume_fn"))
+        return self
+
+    def remote(self, request):
+        return iter(
+            {"token_id": t} for t in (7, 8, 9)
+        )
+
+
+def test_run_open_loop_events_resume_fn_and_token_recording():
+    """ScheduledEvents fire at their offsets with outcomes recorded on the
+    result (an event exception is data, not a run failure); the
+    stream_resume_fn threads through to every dispatch; record_tokens
+    captures the exact delivered ids per sample."""
+    from ray_tpu.loadgen import ScheduledEvent, run_open_loop
+    from ray_tpu.llm.serve import llm_stream_resume
+
+    spec = ScenarioSpec(
+        name="repetitive", num_requests=3, seed=0, max_new_tokens=4
+    )
+    requests = generate_requests(spec)
+    offsets = [0.0, 0.02, 0.04]
+    fired = []
+
+    def boom():
+        raise RuntimeError("chaos hook failed")
+
+    events = [
+        ScheduledEvent(offset_s=0.01, name="ok", fn=lambda: fired.append(1)),
+        ScheduledEvent(offset_s=0.03, name="boom", fn=boom),
+    ]
+    handle = _StubStreamHandle()
+    result = run_open_loop(
+        handle,
+        requests,
+        offsets,
+        timeout_s=5.0,
+        settle_timeout_s=10.0,
+        events=events,
+        stream_resume_fn=llm_stream_resume,
+        record_tokens=True,
+    )
+    assert fired == [1]
+    ok, boom_ev = result.events
+    assert ok.fired_s is not None and ok.error is None
+    assert boom_ev.fired_s is not None
+    assert "chaos hook failed" in boom_ev.error
+    # Events ride the serialized result.
+    d = result.to_dict()
+    assert [e["name"] for e in d["events"]] == ["ok", "boom"]
+    # Every dispatch carried the resume fn; every sample captured tokens.
+    assert handle.resume_fns == [llm_stream_resume] * 3
+    for s in result.samples:
+        assert s.token_ids == [7, 8, 9]
+        assert s.num_tokens == 3
+    # Without record_tokens the field stays None (no memory cost).
+    result2 = run_open_loop(
+        _StubStreamHandle(), requests, offsets, timeout_s=5.0,
+        settle_timeout_s=10.0,
+    )
+    assert all(s.token_ids is None for s in result2.samples)

@@ -803,3 +803,315 @@ def test_listen_for_change_timeout_immune_to_wallclock(monkeypatch):
     )
     assert time.monotonic() - start < 4.0
     assert result["version"] == 0
+
+
+# ---------------- replica lifecycle: drain + SLO autoscaling ----------------
+
+
+def _fake_state(autoscaling_config):
+    """A bare _DeploymentState for pure policy/window unit tests."""
+    from ray_tpu.serve._private.controller import _DeploymentState
+    from ray_tpu.serve.config import DeploymentConfig
+
+    return _DeploymentState(
+        "app",
+        "dep",
+        {"config": DeploymentConfig(autoscaling_config=autoscaling_config)},
+    )
+
+
+def test_look_back_window_average_prevents_flap():
+    """Satellite: AutoscalingConfig.look_back_period_s is real — the
+    controller feeds desired_replicas the window AVERAGE of the
+    ongoing-requests metric, so one bursty sample cannot trigger a
+    scale-up, and one idle sample amid sustained load cannot trigger a
+    scale-down (the oscillation the single-sample policy was prone to)."""
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    cfg = AutoscalingConfig(
+        min_replicas=1,
+        max_replicas=4,
+        target_num_ongoing_requests_per_replica=1.0,
+        look_back_period_s=1.0,
+    )
+    st = _fake_state(cfg)
+    st.replicas = {"t0": object()}
+    # 20 light samples, then ONE 8-request burst sample. The single-sample
+    # policy would have jumped straight to 4 replicas on the burst; the
+    # window average ((20*0.5 + 8) / 21 ≈ 0.86) stays under target.
+    for i in range(20):
+        st.observe_metrics_locked(i * 0.05, 0.5, [])
+    st.observe_metrics_locked(1.0, 8.0, [])
+    assert st.target_replicas(now=1.0) == 1  # no flap on one burst sample
+
+    # Sustained load fills the window: now the same signal scales up.
+    for i in range(21, 41):
+        st.observe_metrics_locked(i * 0.05, 8.0, [])
+    assert st.target_replicas(now=2.05) == 4
+
+    # Scale-down flap guard: one idle sample amid sustained load.
+    st2 = _fake_state(cfg)
+    st2.replicas = {"t0": object(), "t1": object(), "t2": object(),
+                    "t3": object()}
+    for i in range(20):
+        st2.observe_metrics_locked(i * 0.05, 4.0, [])
+    st2.observe_metrics_locked(1.0, 0.0, [])
+    assert st2.target_replicas(now=1.0) == 4
+
+
+def test_llm_autoscaling_policy_decisions():
+    """LLMAutoscalingPolicy unit semantics: hot on any exceeded target,
+    cold only on a COMPLETE quiet window with no backlog, silence never
+    scales up, backlog blocks scale-down, bounds clamp."""
+    from ray_tpu.serve import LLMAutoscalingPolicy
+
+    p = LLMAutoscalingPolicy(
+        min_replicas=1,
+        max_replicas=3,
+        target_queue_time_p99_s=0.1,
+        target_ttft_p99_s=0.5,
+        downscale_margin=0.5,
+    )
+    hot_q = {"queue_time_p99_s": 0.2, "ttft_p99_s": 0.01,
+             "prefill_backlog_tokens": 0, "window_complete": True}
+    cold = {"queue_time_p99_s": 0.01, "ttft_p99_s": 0.01,
+            "prefill_backlog_tokens": 0, "window_complete": True}
+    idle = {"queue_time_p99_s": None, "ttft_p99_s": None,
+            "prefill_backlog_tokens": 0, "window_complete": True}
+    partial = {"queue_time_p99_s": None, "ttft_p99_s": None,
+               "prefill_backlog_tokens": 0, "window_complete": False}
+    warm = {"queue_time_p99_s": 0.08, "ttft_p99_s": 0.01,
+            "prefill_backlog_tokens": 0, "window_complete": True}
+    backlogged = {"queue_time_p99_s": None, "ttft_p99_s": None,
+                  "prefill_backlog_tokens": 500, "window_complete": True}
+    decode_bound = {"queue_time_p99_s": None, "ttft_p99_s": None,
+                    "prefill_backlog_tokens": 0, "window_complete": True,
+                    "decode_saturated": True}
+    assert p.desired_replicas(hot_q, 1) == 2  # one step up
+    assert p.desired_replicas(hot_q, 3) == 3  # clamped at max
+    assert p.desired_replicas(cold, 2) == 1  # quiet full window: step down
+    assert p.desired_replicas(cold, 1) == 1  # clamped at min
+    assert p.desired_replicas(idle, 2) == 1  # idle window counts as cold
+    assert p.desired_replicas(partial, 2) == 2  # incomplete window: hold
+    # Between margin*target and target: neither hot nor cold (hysteresis
+    # band) — hold.
+    assert p.desired_replicas(warm, 2) == 2
+    # Saturated-but-silent (all slots decoding, backlog queued): the
+    # backlog blocks scale-down even though percentiles are silent.
+    assert p.desired_replicas(backlogged, 2) == 2
+    # Decode-bound silence: long generations produce no admission-time
+    # histogram samples and no prefill backlog, but every decode slot
+    # busy must block scale-down too — not read as idleness.
+    assert p.desired_replicas(decode_bound, 2) == 2
+
+    backlog_policy = LLMAutoscalingPolicy(
+        min_replicas=1, max_replicas=4,
+        max_prefill_backlog_per_replica=100.0,
+    )
+    assert backlog_policy.desired_replicas(backlogged, 2) == 3  # 250/replica
+
+    with pytest.raises(ValueError, match="at least one target"):
+        serve.LLMAutoscalingPolicy()
+    with pytest.raises(ValueError, match="min_replicas"):
+        serve.LLMAutoscalingPolicy(
+            min_replicas=0, target_ttft_p99_s=1.0
+        )
+
+
+def test_replica_drain_rejects_new_and_interrupts_streams():
+    """ReplicaActor drain semantics, no serve stack: after drain(0) new
+    unary AND streaming dispatches bounce with the retryable
+    ReplicaDrainingError; an in-flight stream is interrupted at the
+    deadline with the user generator's cleanup run BEFORE the error
+    propagates (the LLM ingress frees engine resources in that finally)."""
+    from ray_tpu.exceptions import ReplicaDrainingError
+    from ray_tpu.serve._private.replica import ReplicaActor
+
+    cleaned = []
+
+    class Streamy:
+        def __call__(self, n):
+            try:
+                for i in range(n):
+                    yield i
+            finally:
+                cleaned.append(True)
+
+    rep = ReplicaActor("dep", "dep#0", Streamy, (), {})
+    # In-flight stream started BEFORE the drain...
+    gen = rep.handle_request_streaming("__call__", (100,), {})
+    assert next(gen) == 0
+    assert rep.drain(0.0) is True  # deadline already passed
+    # ...gets interrupted at the next pull, after user-generator cleanup.
+    with pytest.raises(ReplicaDrainingError):
+        next(gen)
+    assert cleaned == [True]
+    # New work bounces immediately with the same typed (retryable) error.
+    with pytest.raises(ReplicaDrainingError):
+        rep.handle_request("__call__", (3,), {})
+    with pytest.raises(ReplicaDrainingError):
+        list(rep.handle_request_streaming("__call__", (3,), {}))
+    m = rep.get_metrics()
+    assert m["draining"] is True
+    assert m["num_drain_interrupted"] == 1
+    assert m["num_ongoing_requests"] == 0  # interrupted stream released
+
+
+def test_replica_drain_lets_inflight_finish_within_timeout():
+    """A drain with a generous deadline does NOT interrupt: the in-flight
+    stream runs to completion (zero migrations), only new work bounces."""
+    from ray_tpu.exceptions import ReplicaDrainingError
+    from ray_tpu.serve._private.replica import ReplicaActor
+
+    class Streamy:
+        def __call__(self, n):
+            yield from range(n)
+
+    rep = ReplicaActor("dep", "dep#0", Streamy, (), {})
+    gen = rep.handle_request_streaming("__call__", (5,), {})
+    assert next(gen) == 0
+    rep.drain(30.0)
+    assert list(gen) == [1, 2, 3, 4]  # finishes gracefully
+    with pytest.raises(ReplicaDrainingError):
+        rep.handle_request("__call__", (1,), {})
+    assert rep.get_metrics()["num_drain_interrupted"] == 0
+
+
+def test_scale_down_publishes_shrunk_set_before_stop(serve_instance):
+    """Satellite: the scale-down ordering fix. The shrunk replica set must
+    reach long-pollers BEFORE any stop RPC runs, so routers never
+    dispatch to a dying replica in the gap. A delay injected at
+    controller.drain_replica holds the stop path open; the snapshot must
+    already be shrunk while the victim is still alive and DRAINING."""
+    from ray_tpu._private import fault_injection as fi
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    serve.run(echo.bind(), name="drain-order")
+    controller = get_or_create_controller()
+    _, before = ray_tpu.get(
+        controller.get_replica_snapshot.remote("drain-order", "echo")
+    )
+    assert len(before) == 2
+
+    spec = fi.inject(
+        "controller.drain_replica", action="delay", delay_s=1.5
+    )
+    try:
+        serve.scale_deployment("echo", 1, app_name="drain-order")
+        # The bump precedes the (delayed) drain thread: the snapshot
+        # shrinks well before the 1.5s stop delay elapses.
+        deadline = time.monotonic() + 1.0
+        after = before
+        while time.monotonic() < deadline:
+            _, after = ray_tpu.get(
+                controller.get_replica_snapshot.remote("drain-order", "echo")
+            )
+            if len(after) == 1:
+                break
+            time.sleep(0.02)
+        assert len(after) == 1, "shrunk set not published before the stop"
+        assert spec.hits >= 1  # the stop path is really parked in the delay
+        (victim_tag,) = set(before) - set(after)
+        # The victim is DRAINING — alive and still answering RPCs — not
+        # killed: in-flight work on it keeps running.
+        obs = ray_tpu.get(controller.get_observability.remote())
+        dep = obs["drain-order"]["echo"]
+        assert dep["replica_states"].get(victim_tag) == "DRAINING"
+        victim = before[victim_tag]
+        assert ray_tpu.get(victim.get_metrics.remote(), timeout=5.0)[
+            "draining"
+        ] in (False, True)  # RPC succeeds: the actor is alive
+    finally:
+        fi.remove(spec)
+    # Eventually the drain completes: victim STOPPED, history records it.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        hist = ray_tpu.get(
+            controller.get_replica_state_history.remote("drain-order", "echo")
+        )
+        states = [h["state"] for h in hist if h["tag"] == victim_tag]
+        if states and states[-1] == "STOPPED":
+            break
+        time.sleep(0.05)
+    assert states[-1] == "STOPPED"
+    assert "DRAINING" in states
+
+
+def test_scale_up_failure_keeps_deployment_healthy(serve_instance):
+    """Satellite: controller.start_replica chaos during an autoscale-up
+    leaves the deployment HEALTHY at its current count and retrying —
+    never wedged in DEPLOY_FAILED while live replicas serve."""
+    from ray_tpu._private import fault_injection as fi
+
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_num_ongoing_requests_per_replica": 1,
+            "look_back_period_s": 0.5,
+        },
+        max_concurrent_queries=4,
+    )
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.25)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="upfail")
+    spec = fi.inject(
+        "controller.start_replica", match="upfail", times=None
+    )
+    try:
+        results = []
+
+        def fire():
+            results.append(handle.remote(None).result(timeout_s=30))
+
+        threads = [threading.Thread(target=fire) for _ in range(10)]
+        for t in threads:
+            t.start()
+        # Give the autoscaler time to want more replicas and fail to get
+        # them (every start attempt raises InjectedFault).
+        deadline = time.monotonic() + 8.0
+        saw_attempt = False
+        while time.monotonic() < deadline:
+            st = serve.status()["upfail"]["Slow"]
+            assert st["status"] != "DEPLOY_FAILED", st
+            if spec.fires >= 1:
+                saw_attempt = True
+                if st["status"] == "HEALTHY" and st["num_replicas"] == 1:
+                    break
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+        assert saw_attempt, "autoscale-up start was never attempted"
+        st = serve.status()["upfail"]["Slow"]
+        assert st["status"] == "HEALTHY"
+        assert st["num_replicas"] == 1
+        assert len(results) == 10  # live replica kept serving throughout
+    finally:
+        fi.remove(spec)
+    # With the fault gone, the deployment can actually grow under load.
+    done = []
+
+    def fire2():
+        done.append(handle.remote(None).result(timeout_s=30))
+
+    threads = [threading.Thread(target=fire2) for _ in range(10)]
+    for t in threads:
+        t.start()
+    grew = False
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if serve.status()["upfail"]["Slow"]["num_replicas"] > 1:
+            grew = True
+            break
+        time.sleep(0.05)
+    for t in threads:
+        t.join()
+    assert grew
+    assert len(done) == 10
