@@ -20,7 +20,13 @@ K/V ride-along) and report per-step wall time plus two bandwidth views:
     bytes are the padded read, roughly half the reference's write+read.
 
 Run:  python benchmarks/profile_attn_paged.py [--quick] [--json-out PATH]
-      [--impl pallas|reference|both] [--int8]
+      [--impl pallas|reference|both] [--int8] [--tp N]
+
+--tp N runs every config head-sliced over an N-chip tensor-parallel mesh
+through the engine's dispatcher (shard_map over the `tp` axis) and asserts
+the output matches the single-chip op — the sweep doubles as the parity
+oracle for the mesh path. On CPU use the virtual host-device mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=N).
 
 On CPU the kernel runs in Pallas interpret mode — orders of magnitude
 slower than compiled, useful only for parity. Timings are meaningful on
@@ -44,6 +50,7 @@ from ray_tpu.ops.attention import paged_attention
 from ray_tpu.ops.paged_flash import (
     KV_SCALE_DTYPE,
     kv_pool_bytes,
+    paged_attention_impl,
     paged_flash_attention,
     quantize_kv,
 )
@@ -90,7 +97,7 @@ def _time_step(fn, *args, iters: int) -> float:
 
 def run_config(
     *, phase: str, b: int, s: int, ctx: int, h: int, d: int, bs: int,
-    nb: int, impls, int8: bool, iters: int, dtype,
+    nb: int, impls, int8: bool, iters: int, dtype, mesh=None,
 ) -> None:
     rng = np.random.RandomState(0)
     case = _build_case(rng, b, s, ctx, h, d, bs, nb, dtype, int8)
@@ -120,19 +127,44 @@ def run_config(
         2 * b * (nb + 1) * bs * h * (d * kv_elem + scale_b)
         + 4 * b * s * h * d * elem
     )
+    tp = mesh.shape["tp"] if mesh is not None else 1
     for impl in impls:
         op = paged_flash_attention if impl == "pallas" else paged_attention
-        fn = jax.jit(
-            lambda q, kc, vc, t, l, nk, nv, op=op: op(
-                q, kc, vc, t, l, new_k=nk, new_v=nv,
+        if mesh is not None:
+            # Tensor-parallel axis: the SAME op head-sliced over the tp
+            # mesh via the engine's dispatcher (shard_map, each instance
+            # sees h/tp local heads). Outputs must match the single-chip
+            # run — the sweep is also the parity oracle for the mesh path.
+            fn = jax.jit(
+                lambda q, kc, vc, t, l, nk, nv, impl=impl: (
+                    paged_attention_impl(
+                        q, kc, vc, t, l, new_k=nk, new_v=nv,
+                        k_scale=ks, v_scale=vs, impl=impl, mesh=mesh,
+                    )
+                )
+            )
+            base = op(
+                q, kc, vc, tables, lens, new_k=nk, new_v=nv,
                 k_scale=ks, v_scale=vs,
             )
-        )
+            np.testing.assert_allclose(
+                np.asarray(fn(q, kc, vc, tables, lens, nk, nv), np.float32),
+                np.asarray(base, np.float32),
+                atol=5e-2 if dtype == jnp.bfloat16 else 1e-5,
+            )
+        else:
+            fn = jax.jit(
+                lambda q, kc, vc, t, l, nk, nv, op=op: op(
+                    q, kc, vc, t, l, new_k=nk, new_v=nv,
+                    k_scale=ks, v_scale=vs,
+                )
+            )
         dt = _time_step(fn, q, kc, vc, tables, lens, nk, nv, iters=iters)
         _report(
             {
                 "benchmark": f"paged_attn_{phase}",
                 "impl": impl,
+                "tp": tp,
                 "kv": "int8" if int8 else np.dtype(dtype).name,
                 "batch": b,
                 "q_len": s,
@@ -158,8 +190,18 @@ def main() -> None:
                    choices=("both", "pallas", "reference"))
     p.add_argument("--int8", action="store_true",
                    help="also sweep int8 KV pools")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: run every config "
+                        "head-sliced over a tp mesh (parity-asserted "
+                        "against the single-chip op; heads must divide)")
     p.add_argument("--json-out", default="")
     args = p.parse_args()
+
+    mesh = None
+    if args.tp > 1:
+        from ray_tpu.parallel.mesh import tensor_parallel_mesh
+
+        mesh = tensor_parallel_mesh(args.tp)
 
     on_cpu = jax.devices()[0].platform == "cpu"
     if on_cpu:
@@ -186,13 +228,13 @@ def main() -> None:
             run_config(
                 phase="decode", b=b, s=1, ctx=ctx, h=h, d=d, bs=bs,
                 nb=nb_for(ctx), impls=impls, int8=int8, iters=iters,
-                dtype=dtype,
+                dtype=dtype, mesh=mesh,
             )
         for b, s, ctx in prefill_grid:
             run_config(
                 phase="partial_prefill", b=b, s=s, ctx=ctx, h=h, d=d, bs=bs,
                 nb=nb_for(ctx), impls=impls, int8=int8, iters=iters,
-                dtype=dtype,
+                dtype=dtype, mesh=mesh,
             )
 
     # Capacity: sequences resident in the same pool bytes (the reason int8

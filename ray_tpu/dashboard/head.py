@@ -99,6 +99,9 @@ function renderLLM(engines){
     const m=e.metrics,fr=e.flight_record;
     const head=`<p><b class=mono>${esc(e.name)}</b> · `+
       `${m.wedged?'<span class=bad>WEDGED</span>':'<span class=ok>healthy</span>'} · `+
+      ((m.tensor_parallel_size||1)>1?`tp ${m.tensor_parallel_size} · `+
+        `pool ${(m.kv_pool_bytes_per_shard/1048576).toFixed(1)}MiB/chip `+
+        `(${(m.kv_pool_bytes/1048576).toFixed(1)} total) · `:'')+
       `steps ${m.steps} · decode tok ${m.decode_tokens} · `+
       `occupancy ${(m.mean_occupancy??0).toFixed(2)} · `+
       `cache ${(m.cache_utilization??0).toFixed(2)} · `+

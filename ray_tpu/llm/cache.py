@@ -13,6 +13,13 @@ eviction and CoW here need no notion of quantization. int8 halves the
 bytes per cached token, which doubles `num_blocks` for the same HBM: more
 sequences resident, fewer preemptions, better continuous batching.
 
+Block ids are also *shard*-invariant: under tensor parallelism
+(EngineConfig.tensor_parallel_size > 1) the device pools shard on the HEAD
+axis — every chip holds the same [num_blocks, block_size] block grid, just
+its own heads' slice of each block — so this allocator, the prefix cache,
+and the scheduler stay completely host-global and shard-oblivious. The
+bytes that DO change per chip are reported by `kv_pool_bytes_sharded`.
+
 Automatic prefix caching (vLLM-style, restated for this allocator):
 
   * Every FULL block of a sequence gets a content key: the chain hash of
@@ -54,6 +61,45 @@ class CacheOutOfBlocks(Exception):
 
 def blocks_for_tokens(num_tokens: int, block_size: int) -> int:
     return -(-num_tokens // block_size)
+
+
+def kv_pool_bytes_sharded(
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    num_heads: int,
+    head_dim: int,
+    value_itemsize: int,
+    scale_itemsize: Optional[int] = None,
+    tensor_parallel_size: int = 1,
+) -> Dict[str, int]:
+    """Byte accounting for BOTH KV pools (K + V values, plus their scale
+    tensors when quantized) under head-axis tensor parallelism.
+
+    The pools are [L, N, bs, H, D] (scales [L, N, bs, H]) sharded on H, so
+    each chip holds exactly aggregate / tp bytes — the number that decides
+    whether a model's cache fits per-chip HBM, which is what
+    `tensor_parallel_size` exists to change. Pure-int host math (this
+    module is imported by jax-free paths): callers pass itemsizes, e.g.
+    `np.dtype(runner.kv_cache_dtype).itemsize`.
+    """
+    if tensor_parallel_size < 1:
+        raise ValueError("tensor_parallel_size must be >= 1")
+    if num_heads % tensor_parallel_size:
+        raise ValueError(
+            f"num_heads {num_heads} not divisible by tensor_parallel_size "
+            f"{tensor_parallel_size} (the pools shard on the head axis)"
+        )
+    slots = num_layers * num_blocks * block_size * num_heads
+    per_pool = slots * head_dim * value_itemsize
+    if scale_itemsize is not None:
+        per_pool += slots * scale_itemsize
+    aggregate = 2 * per_pool  # K and V
+    return {
+        "aggregate": aggregate,
+        "per_shard": aggregate // tensor_parallel_size,
+        "tensor_parallel_size": tensor_parallel_size,
+    }
 
 
 def hash_block_tokens(

@@ -116,6 +116,26 @@ class EngineConfig:
     # GPTConfig of the draft model (required iff speculation="draft").
     # It must satisfy max_seq_len >= max_model_len, like the target.
     draft_model_config: Optional[Any] = None
+    # Intra-replica tensor parallelism: the number of chips one engine
+    # replica spans. 1 (the default) is the single-chip path, bit-for-bit
+    # unchanged. > 1 builds a `tp` mesh over the first N backend devices
+    # (ray_tpu.parallel.tensor_parallel_mesh) and runs every jitted
+    # program SPMD over it: GPT weights shard Megatron-style (qkv/mlp-in
+    # column-parallel, attn-out/mlp-out row-parallel — one psum per block
+    # after each row-parallel projection), and the paged KV pools, int8
+    # scale pools, and the draft-model mirror pool all shard on the HEAD
+    # axis, so each chip's paged_flash instance DMAs only its local heads'
+    # cache blocks while the allocator/prefix cache/scheduler stay
+    # host-global (block ids are shard-invariant). Requires num_heads of
+    # the target AND draft model to be divisible by this, and at least
+    # this many backend devices — both checked fail-fast at construction.
+    # Both attn_impl values are supported (the implementation runs
+    # head-sliced under shard_map either way). Greedy outputs are
+    # token-identical to tensor_parallel_size=1 in the acceptance tests
+    # (f32, CPU host-device mesh); on TPU in bf16 the partial-sum
+    # reduction order differs, so near-tie argmax flips are possible — the
+    # same contract as any kernel swap.
+    tensor_parallel_size: int = 1
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-
@@ -169,6 +189,11 @@ class EngineConfig:
             raise ValueError(
                 "max_prefill_tokens_per_step must be -1 (auto), 0/None "
                 f"(off), or a positive multiple of block_size; got {budget}"
+            )
+        if self.tensor_parallel_size < 1:
+            raise ValueError(
+                "tensor_parallel_size must be >= 1, got "
+                f"{self.tensor_parallel_size}"
             )
         if self.attn_impl not in ("auto", "pallas", "reference"):
             raise ValueError(

@@ -69,6 +69,18 @@ class LLMEngine:
     ):
         self.model_config = model_config or GPTConfig()
         self.engine_config = engine_config or EngineConfig()
+        if self.engine_config.draft_model_config is not None:
+            # Fail fast with a message that names the DRAFT model before
+            # any runner (and its device pools) is built: the draft mirror
+            # pool shards on the same head axis as the target's, so both
+            # head counts must divide the tp degree.
+            from ray_tpu.ops.attention import validate_tp_heads
+
+            validate_tp_heads(
+                self.engine_config.draft_model_config.num_heads,
+                self.engine_config.tensor_parallel_size,
+                role="draft model",
+            )
         self.runner = GPTRunner(
             self.model_config, self.engine_config, params=params, seed=seed
         )
@@ -223,6 +235,10 @@ class LLMEngine:
         # and per-step flight records so the observability plane can
         # attribute a speedup (or regression) to the kernel in production.
         self._attn_impl = self.runner.attn_impl
+        # How many chips this replica's mesh spans: stamped on stats() and
+        # every flight-recorder step record so a fleet operator can tell a
+        # tp=4 replica's step times from a single-chip one at a glance.
+        self._tp = self.runner.tensor_parallel_size
         # Pre-merged tag dicts so the step loop never builds dicts. Full
         # prefill runs model.apply with no paged caches — the knob cannot
         # affect it — so its series is tagged "n/a" rather than letting
@@ -448,6 +464,7 @@ class LLMEngine:
         # duration_s exactly when an operator is staring at the recorder.
         t_step = time.time() if instrument else 0.0
         t_step_p = time.perf_counter() if instrument else 0.0
+        bytes_before = self._host_transfer_bytes() if instrument else 0
 
         self.scheduler.schedule_prefills(ecfg.max_prefills_per_step)
         # Mixed-step dispatch: this step's chunk plan spans newly admitted
@@ -516,6 +533,15 @@ class LLMEngine:
                 "step": self._steps - 1,
                 "phase": phase,
                 "attn_impl": self._attn_impl,
+                "tensor_parallel_size": self._tp,
+                # Explicit host<->device bytes this step moved (program
+                # inputs + sampled tokens, target AND draft runner):
+                # flat in tensor_parallel_size — the tp acceptance tests
+                # assert the series is identical at tp=1 and tp=2, i.e.
+                # no per-token gather hides in the decode loop.
+                "host_transfer_bytes": (
+                    self._host_transfer_bytes() - bytes_before
+                ),
                 "batch_size": len(decoding),
                 "num_prefills": len(plans),
                 "prefills": prefill_info,
@@ -554,6 +580,20 @@ class LLMEngine:
             "evictable_blocks": self.allocator.num_evictable,
             "prefill_backlog_tokens": backlog,
         }
+
+    def _host_transfer_bytes(self) -> int:
+        """Cumulative explicit host<->device bytes across the target
+        runner AND the draft-model runner (whose mirror pool shards the
+        same way): the per-step delta rides the flight records."""
+        total = self.runner.host_transfer_bytes()
+        spec_runner = (
+            getattr(self._spec, "runner", None)
+            if self._spec is not None
+            else None
+        )
+        if spec_runner is not None:
+            total += spec_runner.host_transfer_bytes()
+        return total
 
     def _run_decode(self, decoding: List[Sequence]) -> None:
         """One iteration-level decode dispatch: every running sequence
@@ -941,10 +981,21 @@ class LLMEngine:
 
     def stats(self) -> dict:
         elapsed = max(time.monotonic() - self._start, 1e-9)
+        # Per-chip vs aggregate cache bytes: the pools shard on the head
+        # axis, so each chip holds aggregate / tensor_parallel_size — the
+        # number that decides whether a model's cache fits per-chip HBM.
+        pool_bytes = self.runner.kv_pool_bytes()
         return {
             "engine_id": self._metric_tags["engine"],
             "attn_impl": self._attn_impl,
             "kv_cache_dtype": self.runner.kv_cache_dtype_str,
+            "tensor_parallel_size": self._tp,
+            "kv_pool_bytes": pool_bytes["aggregate"],
+            "kv_pool_bytes_per_shard": pool_bytes["per_shard"],
+            # PartitionSpec of the live pools (None at tp=1): proof the
+            # cache is still head-sharded after whatever traffic ran.
+            "kv_pool_sharding": self.runner.pool_sharding_spec(),
+            "host_transfer_bytes": self._host_transfer_bytes(),
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
             "mean_occupancy": (

@@ -27,19 +27,31 @@ Serving hot-path knobs (EngineConfig):
     per-head scale tensors [L, N, bs, H] (scales ride every scatter and
     block copy); dequantization is fused into the attention op. ~1.9x the
     sequences fit the same pool bytes.
+  * ``tensor_parallel_size`` — > 1 builds a `tp` mesh over the backend
+    devices and runs ALL FIVE programs SPMD over it: weights shard
+    Megatron-style from the model's logical axis annotations, the cache /
+    scale pools shard on the HEAD axis (the axis ``paged_flash`` already
+    loops over, so each chip's kernel instance DMAs only its local heads'
+    cache blocks), attention runs head-sliced under shard_map, and the
+    donated pool buffers stay sharded through every step (the returned
+    pools carry an explicit sharding constraint, so donation aliases
+    buffer-for-buffer and nothing ever gathers). Block ids are
+    shard-invariant — the allocator/scheduler stay host-global.
 """
 
 from __future__ import annotations
 
-import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.llm.cache import kv_pool_bytes_sharded
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.models.gpt import GPT, GPTConfig, collect_kv_caches
+from ray_tpu.ops.attention import validate_tp_heads
 from ray_tpu.ops.paged_flash import (
     KV_SCALE_DTYPE,
     quantize_kv,
@@ -47,85 +59,74 @@ from ray_tpu.ops.paged_flash import (
 )
 
 
-class GPTRunner:
-    """Owns the params, the paged cache pools, and the compiled steps."""
+class _StepPrograms:
+    """The five jitted programs for one (model geometry, block size,
+    attention impl, KV dtype, tensor-parallel degree) configuration.
+
+    Shared process-wide through `_step_programs`: jax's compilation cache
+    keys on the *callable*, so per-runner bound methods recompile
+    everything for every engine instance — a replica restart, a draft
+    model, every test engine. One `_StepPrograms` per config makes each
+    (program, shapes) pair compile once per process; a same-config runner
+    built later warms up through pure cache hits. Entries hold only
+    config-derived state (the model *definition*, mesh, pool sharding) —
+    never params or pools — so a cached entry costs bytes, not HBM.
+    """
 
     def __init__(
         self,
         model_config: GPTConfig,
-        engine_config: EngineConfig,
-        params=None,
-        seed: int = 0,
+        block_size: int,
+        attn_impl: str,
+        kv_cache_dtype,
+        tensor_parallel_size: int,
     ):
-        if engine_config.max_model_len > model_config.max_seq_len:
-            raise ValueError(
-                f"cache capacity {engine_config.max_model_len} tokens/seq "
-                f"exceeds model max_seq_len {model_config.max_seq_len}"
-            )
         self.model_config = model_config
-        self.engine_config = engine_config
+        self.block_size = block_size
+        self.attn_impl = attn_impl
+        self.kv_cache_dtype = kv_cache_dtype
+        self.quantized = kv_cache_dtype == jnp.int8
         self.model = GPT(model_config)
-        if params is None:
-            probe = jnp.zeros((1, engine_config.block_size), jnp.int32)
-            params = self.model.init(jax.random.PRNGKey(seed), probe)
-        self.params = params
+        if tensor_parallel_size > 1:
+            from ray_tpu.parallel.mesh import tensor_parallel_mesh
+            from ray_tpu.parallel.sharding import llm_pool_sharding
 
-        # Resolved once: the jitted programs below bake the choice in.
-        self.attn_impl = resolve_paged_impl(engine_config.attn_impl)
-        self.kv_cache_dtype = {
-            "auto": model_config.dtype,
-            "bf16": jnp.bfloat16,
-            "int8": jnp.int8,
-        }[engine_config.kv_cache_dtype]
-        self.quantized = self.kv_cache_dtype == jnp.int8
-        # What the pools actually store, in the knob's vocabulary —
-        # observability reports this, not the configured string, so
-        # "auto" never leaks to dashboards.
-        self.kv_cache_dtype_str = {
-            jnp.bfloat16: "bf16", jnp.int8: "int8"
-        }.get(self.kv_cache_dtype, jnp.dtype(self.kv_cache_dtype).name)
-
-        cfg, ecfg = model_config, engine_config
-        cache_shape = (
-            cfg.num_layers,
-            ecfg.num_blocks,
-            ecfg.block_size,
-            cfg.num_heads,
-            cfg.head_dim,
-        )
-        self.k_cache = jnp.zeros(cache_shape, self.kv_cache_dtype)
-        self.v_cache = jnp.zeros(cache_shape, self.kv_cache_dtype)
-        if self.quantized:
-            scale_shape = cache_shape[:-1]  # [L, N, bs, H]
-            self.k_scale = jnp.zeros(scale_shape, KV_SCALE_DTYPE)
-            self.v_scale = jnp.zeros(scale_shape, KV_SCALE_DTYPE)
+            self.mesh = tensor_parallel_mesh(tensor_parallel_size)
+            self.pool_sharding = llm_pool_sharding(self.mesh)
         else:
-            self.k_scale = None
-            self.v_scale = None
-        self._decode_fn = jax.jit(
+            self.mesh = None
+            self.pool_sharding = None
+        self.decode_fn = jax.jit(
             self._decode_step, donate_argnums=(1, 2, 3, 4)
         )
-        self._verify_fn = jax.jit(
+        self.verify_fn = jax.jit(
             self._verify_step, donate_argnums=(1, 2, 3, 4)
         )
-        self._prefill_fn = jax.jit(
+        self.prefill_fn = jax.jit(
             self._prefill_step, donate_argnums=(1, 2, 3, 4)
         )
-        self._prefill_suffix_fn = jax.jit(
+        self.prefill_suffix_fn = jax.jit(
             self._prefill_suffix_step, donate_argnums=(1, 2, 3, 4)
         )
-        self._copy_block_fn = jax.jit(
+        self.copy_block_fn = jax.jit(
             self._copy_block_step, donate_argnums=(0, 1, 2, 3)
         )
 
-    # ---------------- pool plumbing ----------------
+    # ---------------- traced helpers ----------------
 
-    @property
-    def _pools(self):
-        return (self.k_cache, self.v_cache, self.k_scale, self.v_scale)
-
-    def _set_pools(self, pools) -> None:
-        self.k_cache, self.v_cache, self.k_scale, self.v_scale = pools
+    def _constrain_pools(self, pools):
+        """Pin the returned pools to the head-sharded layout inside every
+        jitted program: the constraint makes the donated input buffers and
+        the outputs provably alias (same shape, dtype AND sharding), so no
+        step can silently reshard — or worse, gather — a pool."""
+        if self.pool_sharding is None:
+            return pools
+        return tuple(
+            p
+            if p is None
+            else jax.lax.with_sharding_constraint(p, self.pool_sharding)
+            for p in pools
+        )
 
     def _paged_caches(self, k_cache, v_cache, k_scale, v_scale,
                       block_tables, context_lens):
@@ -140,7 +141,7 @@ class GPTRunner:
             return quantize_kv(new_kv)
         return new_kv.astype(self.kv_cache_dtype), None
 
-    # ---------------- prefill ----------------
+    # ---------------- the five step programs ----------------
 
     def _prefill_step(
         self, params, k_cache, v_cache, k_scale, v_scale, tokens, blocks,
@@ -148,14 +149,15 @@ class GPTRunner:
     ):
         """tokens [1, S_bucket], blocks [S_bucket // bs] (0-padded),
         true_len scalar → (pools, next_token)."""
-        cfg, ecfg = self.model_config, self.engine_config
+        cfg = self.model_config
         logits, state = self.model.apply(
-            params, tokens, return_kv=True, mutable=["intermediates"]
+            params, tokens, return_kv=True, mutable=["intermediates"],
+            paged_mesh=self.mesh,
         )
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
         s = tokens.shape[1]
-        nb = s // ecfg.block_size
-        paged = (nb, ecfg.block_size, cfg.num_heads, cfg.head_dim)
+        nb = s // self.block_size
+        paged = (nb, self.block_size, cfg.num_heads, cfg.head_dim)
         for layer, (k, v) in enumerate(kvs):
             kq, ks = self._store_kv(k[0])
             vq, vs = self._store_kv(v[0])
@@ -169,32 +171,8 @@ class GPTRunner:
                     vs.reshape(paged[:-1])
                 )
         next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
-        return (k_cache, v_cache, k_scale, v_scale), next_token
-
-    def prefill(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
-        """Run one prompt through the model, scatter its K/V into the given
-        blocks, and return the greedily-sampled next token."""
-        ecfg = self.engine_config
-        n = len(token_ids)
-        bucket = ecfg.bucket_for(n)
-        nb = bucket // ecfg.block_size
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = token_ids
-        # Bucket padding beyond the sequence's own blocks scatters into the
-        # null block; it is garbage that nothing ever reads unmasked.
-        blocks = np.zeros((nb,), np.int32)
-        blocks[: len(block_ids)] = block_ids
-        pools, next_token = self._prefill_fn(
-            self.params,
-            *self._pools,
-            jnp.asarray(tokens),
-            jnp.asarray(blocks),
-            jnp.int32(n),
-        )
-        self._set_pools(pools)
-        return int(next_token)
-
-    # ---------------- partial prefill (prefix caching) ----------------
+        pools = self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
+        return pools, next_token
 
     def _prefill_suffix_step(
         self, params, k_cache, v_cache, k_scale, v_scale, tokens,
@@ -209,7 +187,7 @@ class GPTRunner:
         prefix through the block table (paged) and to itself causally, and
         its K/V is scattered token-by-token at positions offset..offset+S-1
         (padded lanes land in the null block)."""
-        cfg, ecfg = self.model_config, self.engine_config
+        cfg = self.model_config
         sb = tokens.shape[1]
         lane = jnp.arange(sb)
         valid = lane < true_len
@@ -223,10 +201,11 @@ class GPTRunner:
                 block_table[None, :], jnp.reshape(offset, (1,)),
             ),
             paged_impl=self.attn_impl,
+            paged_mesh=self.mesh,
             mutable=["intermediates"],
         )
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
-        bs = ecfg.block_size
+        bs = self.block_size
         block_ids = jnp.where(valid, block_table[positions // bs], 0)
         offsets = jnp.where(valid, positions % bs, 0)
         for layer, (k, v) in enumerate(kvs):
@@ -238,32 +217,8 @@ class GPTRunner:
                 k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
                 v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
-        return (k_cache, v_cache, k_scale, v_scale), next_token
-
-    def prefill_suffix(
-        self, token_ids: Sequence[int], block_ids: Sequence[int], offset: int
-    ) -> int:
-        """Prefix-aware prefill: run only the uncached suffix of a prompt
-        whose first `offset` tokens already sit in the paged cache (through
-        `block_ids`, the sequence's whole block table), scatter the suffix
-        K/V, and return the greedily-sampled next token."""
-        ecfg = self.engine_config
-        n = len(token_ids)
-        bucket = ecfg.bucket_for(n)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = token_ids
-        table = np.zeros((ecfg.max_blocks_per_seq,), np.int32)
-        table[: len(block_ids)] = block_ids
-        pools, next_token = self._prefill_suffix_fn(
-            self.params,
-            *self._pools,
-            jnp.asarray(tokens),
-            jnp.asarray(table),
-            jnp.int32(offset),
-            jnp.int32(n),
-        )
-        self._set_pools(pools)
-        return int(next_token)
+        pools = self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
+        return pools, next_token
 
     def _copy_block_step(self, k_cache, v_cache, k_scale, v_scale, src, dst):
         k_cache = k_cache.at[:, dst].set(k_cache[:, src])
@@ -273,16 +228,7 @@ class GPTRunner:
             # or the CoW copy would be read back at the wrong magnitude.
             k_scale = k_scale.at[:, dst].set(k_scale[:, src])
             v_scale = v_scale.at[:, dst].set(v_scale[:, src])
-        return k_cache, v_cache, k_scale, v_scale
-
-    def copy_block(self, src: int, dst: int) -> None:
-        """Device-copy one block's K/V (and scales) across every layer
-        (copy-on-write before a sequence writes into a shared block)."""
-        self._set_pools(
-            self._copy_block_fn(*self._pools, jnp.int32(src), jnp.int32(dst))
-        )
-
-    # ---------------- decode ----------------
+        return self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
 
     def _decode_step(
         self, params, k_cache, v_cache, k_scale, v_scale, tokens, positions,
@@ -290,8 +236,7 @@ class GPTRunner:
     ):
         """One iteration-level decode over all slots. tokens/positions [B],
         block_tables [B, nb], context_lens [B] → (pools, next_tokens [B])."""
-        cfg = self.model_config
-        bs = self.engine_config.block_size
+        bs = self.block_size
         b = tokens.shape[0]
         logits, state = self.model.apply(
             params,
@@ -301,9 +246,12 @@ class GPTRunner:
                 k_cache, v_cache, k_scale, v_scale, block_tables, context_lens
             ),
             paged_impl=self.attn_impl,
+            paged_mesh=self.mesh,
             mutable=["intermediates"],
         )
-        kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
+        kvs = collect_kv_caches(
+            state["intermediates"], self.model_config.num_layers
+        )
         # Scatter each slot's new-token K/V at its absolute position. Idle
         # slots carry an all-null block table, so they land in block 0.
         block_ids = block_tables[jnp.arange(b), positions // bs]
@@ -317,9 +265,8 @@ class GPTRunner:
                 k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
                 v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         next_tokens = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-        return (k_cache, v_cache, k_scale, v_scale), next_tokens
-
-    # ---------------- k-token verification (speculative decoding) ----------
+        pools = self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
+        return pools, next_tokens
 
     def _verify_step(
         self, params, k_cache, v_cache, k_scale, v_scale, tokens,
@@ -349,7 +296,7 @@ class GPTRunner:
         commits the longest proposal prefix agreeing with `out` and rolls
         the rest back (Scheduler.rollback); rejected lanes' K/V stays
         masked above the rewound context length."""
-        cfg, ecfg = self.model_config, self.engine_config
+        cfg = self.model_config
         b, s = tokens.shape
         lane = jnp.arange(s)[None, :]
         valid = lane < true_lens[:, None]  # [B, S]
@@ -363,10 +310,11 @@ class GPTRunner:
                 context_lens,
             ),
             paged_impl=self.attn_impl,
+            paged_mesh=self.mesh,
             mutable=["intermediates"],
         )
         kvs = collect_kv_caches(state["intermediates"], cfg.num_layers)
-        bs = ecfg.block_size
+        bs = self.block_size
         rows = jnp.arange(b)[:, None]
         block_ids = jnp.where(
             valid, block_tables[rows, positions // bs], 0
@@ -381,7 +329,290 @@ class GPTRunner:
                 k_scale = k_scale.at[layer, block_ids, offsets].set(ks)
                 v_scale = v_scale.at[layer, block_ids, offsets].set(vs)
         out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (k_cache, v_cache, k_scale, v_scale), out
+        pools = self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
+        return pools, out
+
+
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_LOCK = threading.Lock()
+
+
+def _step_programs(
+    model_config: GPTConfig,
+    block_size: int,
+    attn_impl: str,
+    kv_cache_dtype,
+    tensor_parallel_size: int,
+) -> _StepPrograms:
+    """Process-wide config-keyed cache of `_StepPrograms`. The key is
+    everything the traced programs close over: the (frozen, hashable)
+    model config, the block size (the only EngineConfig field the traced
+    bodies read — all other geometry arrives through argument shapes, which
+    jax's own cache keys on), the resolved attention impl and pool dtype,
+    and the tp degree (the mesh is deterministic given the backend's
+    devices, which are fixed for the process). A constructor failure (e.g.
+    tp exceeding the device count) propagates without caching."""
+    key = (
+        model_config,
+        block_size,
+        attn_impl,
+        np.dtype(kv_cache_dtype).name,
+        tensor_parallel_size,
+    )
+    with _PROGRAM_CACHE_LOCK:
+        programs = _PROGRAM_CACHE.get(key)
+        if programs is None:
+            programs = _StepPrograms(
+                model_config, block_size, attn_impl, kv_cache_dtype,
+                tensor_parallel_size,
+            )
+            _PROGRAM_CACHE[key] = programs
+    return programs
+
+
+class GPTRunner:
+    """Owns the params, the paged cache pools, and the compiled steps."""
+
+    def __init__(
+        self,
+        model_config: GPTConfig,
+        engine_config: EngineConfig,
+        params=None,
+        seed: int = 0,
+    ):
+        if engine_config.max_model_len > model_config.max_seq_len:
+            raise ValueError(
+                f"cache capacity {engine_config.max_model_len} tokens/seq "
+                f"exceeds model max_seq_len {model_config.max_seq_len}"
+            )
+        self.model_config = model_config
+        self.engine_config = engine_config
+        # Intra-replica tensor parallelism: one mesh with a `tp` axis over
+        # the first tensor_parallel_size backend devices; None at tp=1 so
+        # the single-chip path stays bit-for-bit unchanged (no device_put,
+        # no sharding constraints, no shard_map anywhere below).
+        self.tensor_parallel_size = engine_config.tensor_parallel_size
+        validate_tp_heads(model_config.num_heads, self.tensor_parallel_size)
+
+        # Resolved once: the jitted programs below bake the choice in.
+        self.attn_impl = resolve_paged_impl(engine_config.attn_impl)
+        self.kv_cache_dtype = {
+            "auto": model_config.dtype,
+            "bf16": jnp.bfloat16,
+            "int8": jnp.int8,
+        }[engine_config.kv_cache_dtype]
+        self.quantized = self.kv_cache_dtype == jnp.int8
+        # What the pools actually store, in the knob's vocabulary —
+        # observability reports this, not the configured string, so
+        # "auto" never leaks to dashboards.
+        self.kv_cache_dtype_str = {
+            jnp.bfloat16: "bf16", jnp.int8: "int8"
+        }.get(self.kv_cache_dtype, jnp.dtype(self.kv_cache_dtype).name)
+
+        # The compiled step programs (and the mesh/model/sharding they
+        # close over) come from the process-wide config-keyed cache: a
+        # same-config runner built later — replica restart, draft model,
+        # another test engine — reuses the already-compiled executables.
+        self._programs = _step_programs(
+            model_config,
+            engine_config.block_size,
+            self.attn_impl,
+            self.kv_cache_dtype,
+            self.tensor_parallel_size,
+        )
+        self.model = self._programs.model
+        self.mesh = self._programs.mesh
+        self._pool_sharding = self._programs.pool_sharding
+        if params is None:
+            probe = jnp.zeros((1, engine_config.block_size), jnp.int32)
+            if self.mesh is not None:
+                # Seed-init on the host CPU: the full tree must never
+                # materialize on one accelerator chip (a tp-sharded model
+                # may exceed per-chip HBM — the situation tp exists for).
+                # llm_shard_params below then device_puts each leaf
+                # straight from host memory into its Megatron placement,
+                # the same host->shards path a numpy checkpoint takes.
+                with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                    params = self.model.init(jax.random.PRNGKey(seed), probe)
+            else:
+                params = self.model.init(jax.random.PRNGKey(seed), probe)
+        if self.mesh is not None:
+            # Megatron-style weight placement from the model's logical axis
+            # annotations (parallel.sharding.LLM_TP_RULES): qkv/mlp-in
+            # column-parallel, attn-out/mlp-out row-parallel, embeddings
+            # and norms replicated. Works on freshly-initialized boxed
+            # params and on user checkpoints alike.
+            from ray_tpu.parallel.sharding import llm_shard_params
+
+            params = llm_shard_params(self.mesh, params)
+        self.params = params
+        # Host-transfer accounting: bytes explicitly moved across the
+        # host/device boundary by the program dispatches below (token ids,
+        # block tables, lengths in; sampled token ids out). The pools and
+        # params never appear here — they live donated on the device(s) —
+        # so these counters are flat in tensor_parallel_size by
+        # construction. They are the accounting half of the no-gather
+        # claim; the detection half is pool_sharding_spec() (a desharded
+        # pool after traffic) plus the compiled-HLO gate in
+        # tests/test_llm_tp.py, which asserts the tp=2 decode executable
+        # contains zero all-gather ops (a dropped output-sharding
+        # constraint makes GSPMD gather the pools right there).
+        self.host_bytes_in = 0
+        self.host_bytes_out = 0
+
+        cfg, ecfg = model_config, engine_config
+        cache_shape = (
+            cfg.num_layers,
+            ecfg.num_blocks,
+            ecfg.block_size,
+            cfg.num_heads,
+            cfg.head_dim,
+        )
+        self.k_cache = self._zeros_pool(cache_shape, self.kv_cache_dtype)
+        self.v_cache = self._zeros_pool(cache_shape, self.kv_cache_dtype)
+        if self.quantized:
+            scale_shape = cache_shape[:-1]  # [L, N, bs, H]
+            self.k_scale = self._zeros_pool(scale_shape, KV_SCALE_DTYPE)
+            self.v_scale = self._zeros_pool(scale_shape, KV_SCALE_DTYPE)
+        else:
+            self.k_scale = None
+            self.v_scale = None
+        self._decode_fn = self._programs.decode_fn
+        self._verify_fn = self._programs.verify_fn
+        self._prefill_fn = self._programs.prefill_fn
+        self._prefill_suffix_fn = self._programs.prefill_suffix_fn
+        self._copy_block_fn = self._programs.copy_block_fn
+
+    # ---------------- pool plumbing ----------------
+
+    def _zeros_pool(self, shape, dtype):
+        """Allocate one device pool — under tensor parallelism it is
+        assembled shard-by-shard in the head-sharded layout, so the full
+        pool never materializes on a single chip (a tp-sharded pool may
+        exceed per-chip HBM — the very situation tp exists for)."""
+        if self._pool_sharding is None:
+            return jnp.zeros(shape, dtype)
+
+        def shard_zeros(index):
+            shard_shape = tuple(
+                len(range(*idx.indices(dim)))
+                for idx, dim in zip(index, shape)
+            )
+            return np.zeros(shard_shape, np.dtype(dtype))
+
+        return jax.make_array_from_callback(
+            shape, self._pool_sharding, shard_zeros
+        )
+
+    @property
+    def _pools(self):
+        return (self.k_cache, self.v_cache, self.k_scale, self.v_scale)
+
+    def _set_pools(self, pools) -> None:
+        self.k_cache, self.v_cache, self.k_scale, self.v_scale = pools
+
+    def _count_transfer(self, arrays_in, out) -> None:
+        self.host_bytes_in += sum(int(a.nbytes) for a in arrays_in)
+        self.host_bytes_out += int(out.nbytes)
+
+    def host_transfer_bytes(self) -> int:
+        """Cumulative explicit host<->device bytes across all program
+        dispatches (inputs fed + sampled tokens fetched). Per-step deltas
+        land in the flight-recorder step records; the tp parity tests
+        assert the series is identical at tensor_parallel_size 1 and 2."""
+        return self.host_bytes_in + self.host_bytes_out
+
+    def pool_sharding_spec(self) -> Optional[str]:
+        """The live K-pool's PartitionSpec as a string (None at tp=1):
+        observability surfaces it, and tests assert it still names the
+        head axis after serving traffic — proof no step desharded the
+        cache."""
+        if self.mesh is None:
+            return None
+        return str(self.k_cache.sharding.spec)
+
+    def kv_pool_bytes(self) -> dict:
+        """Aggregate and per-shard bytes of both KV pools (+ scale tensors
+        when quantized): per-chip HBM is aggregate / tensor_parallel_size
+        because the pools shard on the head axis."""
+        cfg, ecfg = self.model_config, self.engine_config
+        return kv_pool_bytes_sharded(
+            cfg.num_layers,
+            ecfg.num_blocks,
+            ecfg.block_size,
+            cfg.num_heads,
+            cfg.head_dim,
+            np.dtype(self.kv_cache_dtype).itemsize,
+            np.dtype(KV_SCALE_DTYPE).itemsize if self.quantized else None,
+            tensor_parallel_size=self.tensor_parallel_size,
+        )
+
+    # ---------------- prefill ----------------
+
+    def prefill(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
+        """Run one prompt through the model, scatter its K/V into the given
+        blocks, and return the greedily-sampled next token."""
+        ecfg = self.engine_config
+        n = len(token_ids)
+        bucket = ecfg.bucket_for(n)
+        nb = bucket // ecfg.block_size
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = token_ids
+        # Bucket padding beyond the sequence's own blocks scatters into the
+        # null block; it is garbage that nothing ever reads unmasked.
+        blocks = np.zeros((nb,), np.int32)
+        blocks[: len(block_ids)] = block_ids
+        pools, next_token = self._prefill_fn(
+            self.params,
+            *self._pools,
+            jnp.asarray(tokens),
+            jnp.asarray(blocks),
+            jnp.int32(n),
+        )
+        self._set_pools(pools)
+        self._count_transfer((tokens, blocks), next_token)
+        return int(next_token)
+
+    # ---------------- partial prefill (prefix caching) ----------------
+
+    def prefill_suffix(
+        self, token_ids: Sequence[int], block_ids: Sequence[int], offset: int
+    ) -> int:
+        """Prefix-aware prefill: run only the uncached suffix of a prompt
+        whose first `offset` tokens already sit in the paged cache (through
+        `block_ids`, the sequence's whole block table), scatter the suffix
+        K/V, and return the greedily-sampled next token."""
+        ecfg = self.engine_config
+        n = len(token_ids)
+        bucket = ecfg.bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = token_ids
+        table = np.zeros((ecfg.max_blocks_per_seq,), np.int32)
+        table[: len(block_ids)] = block_ids
+        pools, next_token = self._prefill_suffix_fn(
+            self.params,
+            *self._pools,
+            jnp.asarray(tokens),
+            jnp.asarray(table),
+            jnp.int32(offset),
+            jnp.int32(n),
+        )
+        self._set_pools(pools)
+        self._count_transfer((tokens, table), next_token)
+        return int(next_token)
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Device-copy one block's K/V (and scales) across every layer
+        (copy-on-write before a sequence writes into a shared block).
+        Under tensor parallelism the copy is shard-local: src and dst
+        address the same blocks on every chip, each chip copies its own
+        heads' slice (scales included)."""
+        self._set_pools(
+            self._copy_block_fn(*self._pools, jnp.int32(src), jnp.int32(dst))
+        )
+        self.host_bytes_in += 8  # two int32 block ids
+
+    # ---------------- decode / k-token verification ----------------
 
     def verify(
         self,
@@ -404,7 +635,11 @@ class GPTRunner:
             jnp.asarray(true_lens, jnp.int32),
         )
         self._set_pools(pools)
-        return np.asarray(out)
+        out = np.asarray(out)
+        self._count_transfer(
+            (tokens, block_tables, context_lens, true_lens), out
+        )
+        return out
 
     def decode(
         self,
@@ -424,4 +659,8 @@ class GPTRunner:
             jnp.asarray(context_lens, jnp.int32),
         )
         self._set_pools(pools)
-        return np.asarray(next_tokens)
+        next_tokens = np.asarray(next_tokens)
+        self._count_transfer(
+            (tokens, positions, block_tables, context_lens), next_tokens
+        )
+        return next_tokens

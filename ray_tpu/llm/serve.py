@@ -278,6 +278,15 @@ def build_app(
     POST /<app> with the request JSON). Pass trained weights via `params`;
     without them the engine serves a seed-initialized model.
 
+    `engine_config.tensor_parallel_size > 1` makes the ONE shared engine
+    actor span a multi-chip mesh (weights Megatron-sharded, KV pools
+    head-sharded — see EngineConfig): scaling `num_replicas` still only
+    adds HTTP ingress replicas, never weight copies, and the engine's
+    stats()/flight records/autoscaling signals all carry the
+    tensor_parallel_size tag plus per-chip pool bytes for the dashboard's
+    /api/llm panel. Warmup compiles every bucket program SPMD over the
+    mesh before the deployment reports healthy, exactly as at tp=1.
+
     `autoscaling_config` accepts serve.LLMAutoscalingPolicy (SLO-driven:
     the ingress feeds the engine's queue-time/TTFT histogram windows and
     prefill backlog to the controller) or the queue-depth

@@ -17,6 +17,13 @@ overwrites it, exactly the target engine's rollback discipline.
 
 The draft cache never feeds the target model: a draft of any quality only
 changes how many proposals survive verification, never the output.
+
+Tensor parallelism rides through for free: the draft's GPTRunner receives
+the SAME engine config, so at tensor_parallel_size > 1 its weights shard
+Megatron-style and its mirror pool shards on its own head axis over the
+same `tp` mesh — which is why the draft model's num_heads must also
+divide the tp degree (validated fail-fast, with a draft-naming error, in
+LLMEngine before anything is built).
 """
 
 from __future__ import annotations

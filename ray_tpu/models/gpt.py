@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.ops import attention as attention_op
+from ray_tpu.ops.attention import head_sharded_attention
 from ray_tpu.ops.flash_attention import flash_attention_packed
 from ray_tpu.ops.paged_flash import paged_attention_impl
 from ray_tpu.ops.ring_attention import ring_attention
@@ -91,6 +92,7 @@ class Block(nn.Module):
         return_kv: bool = False,
         paged_state: Optional[tuple] = None,
         paged_impl: str = "reference",
+        paged_mesh: Optional[Any] = None,
     ):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x)
@@ -120,6 +122,7 @@ class Block(nn.Module):
                     new_k=k, new_v=v,
                     k_scale=k_scale_l, v_scale=v_scale_l,
                     impl=paged_impl,
+                    mesh=paged_mesh,
                 )
             else:
                 impl = (
@@ -127,7 +130,20 @@ class Block(nn.Module):
                     if cfg.attention_impl == "ring"
                     else cfg.attention_impl
                 )
-                attn = attention_op(q, k, v, causal=True, impl=impl)
+                if (
+                    paged_mesh is not None
+                    and paged_mesh.shape.get("tp", 1) > 1
+                ):
+                    # Full prefill under tensor parallelism: heads are
+                    # independent in attention, so the dense causal pass
+                    # runs head-sliced over the same tp axis as the paged
+                    # programs (the flash kernel can't be auto-partitioned
+                    # by GSPMD — each shard runs it over its local heads).
+                    attn = head_sharded_attention(
+                        paged_mesh, q, k, v, impl=impl
+                    )
+                else:
+                    attn = attention_op(q, k, v, causal=True, impl=impl)
             self.sow("intermediates", "kv_cache", (k, v))
             attn = attn.reshape(b, s, cfg.embed_dim)
         elif cfg.attention_impl == "flash" and s <= 2048:
@@ -186,6 +202,7 @@ class GPT(nn.Module):
         return_kv: bool = False,
         paged_caches: Optional[tuple] = None,
         paged_impl: str = "reference",
+        paged_mesh: Optional[Any] = None,
     ):
         """Forward pass.
 
@@ -204,7 +221,10 @@ class GPT(nn.Module):
             runs causally over the fed tokens — through the fused Pallas
             kernel when ``paged_impl="pallas"``, the XLA reference
             otherwise; the new K/V is sown for the caller to scatter into
-            the cache.
+            the cache. ``paged_mesh`` (a Mesh with a tp axis > 1) runs
+            every attention head-sliced over the tensor-parallel axis —
+            the serving engine passes its intra-replica mesh here so each
+            chip's kernel instance only touches its local heads' cache.
         """
         cfg = self.config
         b, s = tokens.shape
@@ -251,6 +271,7 @@ class GPT(nn.Module):
                 return_kv=return_kv,
                 paged_state=paged_state,
                 paged_impl=paged_impl,
+                paged_mesh=paged_mesh,
             )
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Tied LM head: logits via the embedding matrix. The matmul runs in

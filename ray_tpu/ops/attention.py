@@ -9,7 +9,7 @@ attention absent upstream) — this subsystem is net-new, designed TPU-first.
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,78 @@ def mha_reference(
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
     return out.astype(q.dtype)
+
+
+def validate_tp_heads(
+    num_heads: int, tensor_parallel_size: int, role: str = "model"
+) -> None:
+    """One shared contract for every tensor-parallel entry point (the
+    runner sharding weights/pools, the dispatcher head-slicing the
+    kernels): the head count must divide evenly across the tp axis.
+    Uneven head sharding either trace-fails deep inside GSPMD or pads —
+    both far worse failure modes than this config-time error, and the
+    target and draft model must BOTH pass (a draft with an incompatible
+    head count would shard its mirror pool differently from the target's,
+    breaking the shared block-id geometry)."""
+    if tensor_parallel_size > 1 and num_heads % tensor_parallel_size:
+        raise ValueError(
+            f"{role} num_heads {num_heads} is not divisible by "
+            f"tensor_parallel_size {tensor_parallel_size}: attention heads "
+            "(and with them the paged KV pools) shard on the head axis, so "
+            "every chip must own the same number of heads"
+        )
+
+
+def head_sharded_call(mesh, fn, args, head_args: Sequence[bool]):
+    """Run `fn(*args)` SPMD over the mesh's `tp` axis with the flagged
+    arrays sharded on their head dim and the rest replicated.
+
+    Every head-carrying array in the paged-attention signature puts H at
+    dim 2 — q/new_k/new_v [B, S, H, D], per-layer pools [N, bs, H, D],
+    scale pools [N, bs, H] — so one PartitionSpec covers them all, and
+    inside the shard each kernel instance sees (and DMAs) only its local
+    heads' slice of the cache blocks. Block tables and context lengths
+    replicate: block ids are shard-invariant."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu._private.jax_compat import shard_map
+    from ray_tpu.parallel.sharding import LLM_HEAD_SPEC
+
+    in_specs = tuple(LLM_HEAD_SPEC if h else P() for h in head_args)
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=LLM_HEAD_SPEC,
+        check_vma=False,
+    )(*args)
+
+
+def head_sharded_attention(
+    mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "auto",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense causal attention head-sliced over the mesh's `tp` axis (the
+    full-prefill program under tensor parallelism): q/k/v [B, S, H, D]
+    arrive head-sharded from the column-parallel qkv projection, each
+    shard attends its local heads, and the output stays head-sharded for
+    the row-parallel output projection. No collective — heads never mix
+    inside attention."""
+    from ray_tpu.ops.flash_attention import attention as attention_op
+
+    validate_tp_heads(q.shape[2], mesh.shape["tp"])
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def shard(q, k, v):
+        return attention_op(
+            q, k, v, causal=causal, sm_scale=sm_scale, impl=impl
+        )
+
+    return head_sharded_call(mesh, shard, (q, k, v), (True, True, True))
 
 
 def validate_kv_scales(k_cache, v_cache, k_scale, v_scale) -> None:

@@ -46,8 +46,10 @@ from jax.experimental.pallas import tpu as pltpu
 from ray_tpu.ops.attention import (
     NEG_INF,
     dequantize_kv,  # noqa: F401 — canonical home; re-exported via ops
+    head_sharded_call,
     paged_attention,
     validate_kv_scales,
+    validate_tp_heads,
 )
 from ray_tpu.ops.flash_attention import _CompilerParams, _on_cpu
 
@@ -320,17 +322,53 @@ def paged_attention_impl(
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
     impl: str = "auto",
+    mesh=None,
 ) -> jax.Array:
     """Dispatcher: the fused Pallas kernel on TPU, the XLA reference
     elsewhere (impl='auto'); 'pallas' forces the kernel (interpret mode on
     CPU), 'reference' forces the gather+softmax reference. A cache-only
     query (new_k=None) is outside the kernel's contract: 'auto' falls back
-    to the reference, 'pallas' raises (inside paged_flash_attention)."""
+    to the reference, 'pallas' raises (inside paged_flash_attention).
+
+    `mesh` (a Mesh whose `tp` axis is > 1) runs the chosen implementation
+    head-sliced over the tensor-parallel axis via shard_map: each chip's
+    instance receives only its local heads' q / new-token K/V / cache and
+    scale pool slices, so the kernel's per-block DMA touches local-head
+    bytes only and the attention output comes back head-sharded with no
+    collective (heads never mix inside attention — the psum this layering
+    implies happens later, in the attn output projection)."""
     resolved = resolve_paged_impl(impl)
     use_reference = resolved == "reference" or (
         impl == "auto" and new_k is None
     )
     op = paged_attention if use_reference else paged_flash_attention
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        validate_tp_heads(q.shape[2], mesh.shape["tp"])
+        if sm_scale is None:
+            sm_scale = 1.0 / math.sqrt(q.shape[-1])
+        args = [q, k_cache, v_cache, block_tables, context_lens]
+        head_args = [True, True, True, False, False]
+        if new_k is not None:
+            args += [new_k, new_v]
+            head_args += [True, True]
+        if k_scale is not None:
+            args += [k_scale, v_scale]
+            head_args += [True, True]
+
+        def sharded(q, k_cache, v_cache, block_tables, context_lens,
+                    *rest):
+            nk = nv = ks = vs = None
+            if new_k is not None:
+                nk, nv, *rest = rest
+            if k_scale is not None:
+                ks, vs = rest
+            return op(
+                q, k_cache, v_cache, block_tables, context_lens,
+                new_k=nk, new_v=nv, sm_scale=sm_scale,
+                k_scale=ks, v_scale=vs,
+            )
+
+        return head_sharded_call(mesh, sharded, args, head_args)
     return op(
         q, k_cache, v_cache, block_tables, context_lens,
         new_k=new_k, new_v=new_v, sm_scale=sm_scale,

@@ -3,6 +3,7 @@ from ray_tpu.parallel.mesh import (
     MeshSpec,
     SliceTopology,
     auto_mesh,
+    tensor_parallel_mesh,
 )
 from ray_tpu.parallel.mesh_group import MeshHostWorker, MeshWorkerGroup
 from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
@@ -10,6 +11,7 @@ from ray_tpu.parallel.sharding import (
     DP_RULES,
     EP_RULES,
     FSDP_RULES,
+    LLM_TP_RULES,
     SP_RULES,
     STRATEGY_RULES,
     TP_RULES,
@@ -26,6 +28,7 @@ __all__ = [
     "DP_RULES",
     "EP_RULES",
     "FSDP_RULES",
+    "LLM_TP_RULES",
     "MeshHostWorker",
     "MeshSpec",
     "MeshWorkerGroup",
@@ -41,5 +44,6 @@ __all__ = [
     "replicated",
     "spec_for",
     "stack_stage_params",
+    "tensor_parallel_mesh",
     "tree_shardings",
 ]

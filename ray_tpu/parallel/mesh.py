@@ -104,6 +104,30 @@ def auto_mesh(
     raise ValueError(f"Unknown mesh strategy {strategy!r}")
 
 
+def tensor_parallel_mesh(tensor_parallel_size: int, devices=None):
+    """The LLM serving engine's intra-replica mesh: `tp` over the first
+    `tensor_parallel_size` backend devices, every other axis size 1.
+
+    Fails fast with an actionable error when the backend exposes fewer
+    devices than requested — an engine that silently fell back to fewer
+    chips would serve with the wrong per-chip memory budget."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if tensor_parallel_size > len(devices):
+        raise ValueError(
+            f"tensor_parallel_size {tensor_parallel_size} exceeds the "
+            f"{len(devices)} device(s) the backend exposes "
+            f"({devices[0].platform}); shrink tensor_parallel_size or run "
+            "on a larger slice (CPU tests: raise "
+            "--xla_force_host_platform_device_count)"
+        )
+    return MeshSpec(tp=tensor_parallel_size).build(
+        devices[:tensor_parallel_size]
+    )
+
+
 @dataclass
 class SliceTopology:
     """Description of a TPU slice as scheduled by the placement layer:

@@ -153,3 +153,59 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ---------------- LLM serving: intra-replica tensor parallelism ----------------
+
+# The serving engine's rules table (ray_tpu.llm with
+# EngineConfig.tensor_parallel_size > 1): pure Megatron-style TP over the
+# `tp` mesh axis, nothing else. Attention heads and the MLP intermediate
+# shard (qkv / mlp-in kernels column-parallel, attn-proj / mlp-out kernels
+# row-parallel — each block pays exactly one psum after attn-proj and one
+# after mlp-out, inserted by GSPMD); embeddings, layernorms, and the tied
+# LM head stay replicated so the per-slot argmax needs no gather. The paged
+# KV pools shard on the SAME head axis (see llm/model_runner.py), which is
+# what makes block ids shard-invariant: every chip holds the same blocks,
+# just its own heads' slice of them.
+LLM_TP_RULES: RuleTable = {
+    **DP_RULES,
+    "batch": None,
+    "mlp": "tp",
+    "heads": "tp",
+}
+
+# Head-carrying engine arrays all put H at dim 2 — queries/new K/V
+# [B, S, H, D], per-layer cache pools [N, bs, H, D], scale pools
+# [N, bs, H] — so one spec covers the whole paged-attention signature.
+LLM_HEAD_SPEC = P(None, None, "tp")
+# Full cache/scale pools [L, N, bs, H, ...]: H at dim 3.
+LLM_POOL_SPEC = P(None, None, None, "tp")
+
+
+def llm_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the runner's [L, N, bs, H, D] KV pools and
+    [L, N, bs, H] int8 scale pools (one spec fits both: H is dim 3)."""
+    return NamedSharding(mesh, LLM_POOL_SPEC)
+
+
+def llm_shard_params(mesh: Mesh, params: Any) -> Any:
+    """Place a GPT param tree onto the serving mesh under LLM_TP_RULES
+    (boxed metadata is preserved — flax unboxes at apply time).
+
+    Flax-initialized params carry logical axis names in their
+    `nn.LogicallyPartitioned` boxes (models/gpt.py annotates every weight)
+    — those drive the specs directly. Plain-array trees (a checkpoint
+    saved unboxed) fall back to replication: correct, just not
+    memory-sharded, and nothing in the step loop depends on where a
+    replicated weight lives."""
+    from flax.core import meta
+
+    def put(x):
+        if isinstance(x, meta.AxisMetadata):
+            sharding = named_sharding(mesh, x.names, LLM_TP_RULES)
+            return x.replace_boxed(jax.device_put(x.unbox(), sharding))
+        return jax.device_put(x, replicated(mesh))
+
+    return jax.tree_util.tree_map(
+        put, params, is_leaf=lambda x: isinstance(x, meta.AxisMetadata)
+    )

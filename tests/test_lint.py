@@ -3070,3 +3070,109 @@ def test_function_local_registration_does_not_leak():
         """
     )
     assert "RTL701" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel LLM engine: head-axis PartitionSpecs vs the engine mesh
+# ---------------------------------------------------------------------------
+
+
+def test_llm_tp_head_spec_against_engine_mesh_clean_and_typo_fires():
+    """RTL601 pins the engine's head-axis sharding idiom: the serving mesh
+    is built MeshSpec.build-style over the full AXIS_ORDER tuple, and the
+    head spec P(None, None, 'tp') (ops.attention.head_sharded_call's
+    shape) names an axis that mesh really has — clean. A spec naming an
+    axis the mesh lacks (say the LOGICAL axis name 'heads' leaking in
+    where the MESH axis 'tp' belongs) must fire: under check_vma=False a
+    wrong axis silently means replicated, i.e. every chip would run every
+    head and the tp memory win would quietly vanish."""
+    engine_mesh = """
+        from jax.sharding import Mesh
+
+        AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+        class MeshSpec:
+            def build(self, devs):
+                return Mesh(devs, AXIS_ORDER)
+    """
+    clean = lint_files(
+        {
+            "pkg/mesh.py": engine_mesh,
+            "pkg/runner.py": """
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu._private.jax_compat import shard_map
+                from pkg.mesh import MeshSpec
+
+                def paged_attention_tp(fn, q, k_cache, devs):
+                    mesh = MeshSpec().build(devs)
+                    head_spec = P(None, None, "tp")
+                    f = shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(head_spec, head_spec, P()),
+                        out_specs=head_spec, check_vma=False,
+                    )
+                    return f(q, k_cache, None)
+            """,
+        }
+    )
+    assert "RTL601" not in rules_of(clean)
+
+    typo = lint_files(
+        {
+            "pkg/mesh.py": engine_mesh,
+            "pkg/runner.py": """
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu._private.jax_compat import shard_map
+                from pkg.mesh import MeshSpec
+
+                def paged_attention_tp(fn, q, k_cache, devs):
+                    mesh = MeshSpec().build(devs)
+                    f = shard_map(
+                        fn, mesh=mesh,
+                        in_specs=(P(None, None, "heads"), P()),
+                        out_specs=P(None, None, "heads"), check_vma=False,
+                    )
+                    return f(q, k_cache)
+            """,
+        }
+    )
+    assert "RTL601" in rules_of(typo)
+
+
+def test_llm_tp_pool_head_divisibility_pinned():
+    """RTL803 pins the pool-sharding divisibility rule on the engine's
+    exact layout: a [L, N, bs, H, D] KV pool head-sharded over a tp axis
+    whose size does not divide H fires (the runtime mirror of
+    validate_tp_heads' fail-fast config error); a divisible head count is
+    clean."""
+    bad = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def build_pool():
+            mesh = Mesh(mesh_utils.create_device_mesh((4,)), ("tp",))
+            k_cache = jnp.zeros((2, 16, 4, 6, 8))  # H=6, tp=4: indivisible
+            return jax.device_put(
+                k_cache, NamedSharding(mesh, P(None, None, None, "tp"))
+            )
+    """
+    assert "RTL803" in rules_of(lint(bad))
+
+    good = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def build_pool():
+            mesh = Mesh(mesh_utils.create_device_mesh((4,)), ("tp",))
+            k_cache = jnp.zeros((2, 16, 4, 8, 8))  # H=8 divides tp=4
+            return jax.device_put(
+                k_cache, NamedSharding(mesh, P(None, None, None, "tp"))
+            )
+    """
+    assert "RTL803" not in rules_of(lint(good))

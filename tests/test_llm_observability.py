@@ -375,20 +375,23 @@ def test_streamed_request_yields_one_connected_trace(serve_ray):
         prompt, n_new,
     )
     engine = ray_tpu.get_actor("llm_engine:obs")
-    # Cache pressure: three background generations keep the 11-block pool
+    # Cache pressure: background generations keep the 11-block pool
     # oversubscribed, so the traced stream (youngest arrival) gets
-    # preempted and resumed at least once.
+    # preempted and resumed at least once. Each bg sequence grows to 8
+    # blocks (its max_blocks_per_seq cap), so a 3-request wave holds 24
+    # blocks against the 11-block pool while it lives.
     #
-    # The background streams must OUTLIVE the traced stream, not just
-    # overlap its start: this test used to be the rotating tier-1 flake —
-    # at 12 background tokens the bg requests could drain in the window
-    # between the pressure check below and the traced stream's admission
-    # (a gc pause or a loaded box stretches that window), leaving a full
-    # pool and no preemption to trace. 24 tokens makes the pressure
-    # deterministic by construction: each bg sequence grows to 8 blocks
-    # (its max_blocks_per_seq cap), 3 x 8 = 24 blocks against an 11-block
-    # pool, and ~24 interleaved decode steps comfortably cover the traced
-    # stream's 12 tokens + mid-stream failover + resume.
+    # Two races have made this the tier-1 flake historically, both closed
+    # by construction below rather than by tuning token counts:
+    #  * the FIRST metrics poll can return seconds late (it queues behind
+    #    cold compiles / a loaded box), by which time the wave already
+    #    drained — the loop then RESUBMITS a wave on observing an idle
+    #    engine; once polls are warm (~ms cadence) a fresh 3 x 24-token
+    #    wave is observed for dozens of polls before it can drain;
+    #  * pressure can be observed at the wave's TAIL and drain before the
+    #    traced stream is admitted — so after observing it we TOP UP with
+    #    one more wave, queued behind the live one, spanning the traced
+    #    stream's admission with ≥ 24 further decode steps of pressure.
     bg_prompts = random_prompts((6, 6, 5), seed=8)
     bg = [engine.generate.remote(p, 24) for p in bg_prompts]
     # The traced stream must be the YOUNGEST arrival (the scheduler preempts
@@ -398,7 +401,14 @@ def test_streamed_request_yields_one_connected_trace(serve_ray):
         stats = ray_tpu.get(engine.metrics.remote())
         if stats["num_running"] + stats["queue_depth"] >= 3:
             break
+        if stats["num_running"] + stats["queue_depth"] == 0:
+            bg += [engine.generate.remote(p, 24) for p in bg_prompts]
         time.sleep(0.02)
+    else:
+        raise AssertionError("background pressure never observed")
+    # Top-up wave: still older than the traced stream (submitted next),
+    # still pressure when the live wave drains.
+    bg += [engine.generate.remote(p, 24) for p in bg_prompts]
     # Replica dies after delivering 4 tokens: the router re-dispatches with
     # the delivered tokens folded into the prompt (llm_stream_resume).
     spec = fi.inject(
