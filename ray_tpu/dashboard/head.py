@@ -107,7 +107,15 @@ function renderLLM(engines){
       `cache ${(m.cache_utilization??0).toFixed(2)} · `+
       `hit rate ${(m.prefix_cache_hit_rate??0).toFixed(2)} · `+
       `queue ${m.queue_depth} · preempt ${m.num_preemptions} · `+
-      `dead letters ${m.num_dead_letters}</p>`;
+      `dead letters ${m.num_dead_letters}</p>`+
+      (m.kv_fabric&&m.kv_fabric!=='off'?
+        `<p style="font-size:.8rem">kv fabric <b class=mono>${esc(m.kv_fabric)}</b>`+
+        (m.engine_role&&m.engine_role!=='unified'?` (${esc(m.engine_role)} role)`:'')+
+        ` · hit rate ${(m.fabric_hit_rate??0).toFixed(2)} · `+
+        `spilled ${m.fabric_spill_blocks} / restored ${m.fabric_restore_blocks} blocks · `+
+        `store ${((m.fabric_store?.bytes_used??0)/1048576).toFixed(1)}/`+
+        `${((m.fabric_store?.byte_budget??0)/1048576).toFixed(1)}MiB `+
+        `(${m.fabric_store?.num_blocks??0} blocks, ${m.fabric_store?.evictions??0} evictions)</p>`:'');
     const steps=(fr.steps||[]).slice(-12).map(s=>
       `<tr><td>${s.step}</td><td>${esc(s.phase)}</td><td>${s.batch_size}</td>`+
       `<td>${s.tokens_in}/${s.tokens_out}</td><td>${s.cache_hit_tokens}</td>`+

@@ -13,6 +13,8 @@ Pure-Python library on the actor/object core (the Ray layering principle):
   * spec/ — speculative decoding proposers (n-gram prompt lookup, draft
     model) feeding the engine's k-token verify-with-rollback phase
   * serve.py — ingress deployment behind the existing HTTP proxy/replicas
+  * kvfabric/ — fleet-wide KV fabric: host-DRAM spill tier shared across
+    engines, disaggregated prefill/decode roles, prefix-affinity routing
 """
 
 from ray_tpu.llm.cache import (
@@ -24,7 +26,7 @@ from ray_tpu.llm.cache import (
     hash_block_tokens,
     prefix_block_hashes,
 )
-from ray_tpu.llm.config import EngineConfig
+from ray_tpu.llm.config import EngineConfig, KVFabricConfig
 from ray_tpu.llm.engine import LLMEngine, LLMServer
 from ray_tpu.llm.model_runner import GPTRunner
 from ray_tpu.llm.scheduler import (
@@ -48,6 +50,7 @@ __all__ = [
     "FINISH_ERROR",
     "FINISH_LENGTH",
     "GPTRunner",
+    "KVFabricConfig",
     "LLMEngine",
     "LLMServer",
     "NULL_BLOCK",

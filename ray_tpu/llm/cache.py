@@ -45,7 +45,7 @@ Automatic prefix caching (vLLM-style, restated for this allocator):
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 NULL_BLOCK = 0
 
@@ -159,6 +159,13 @@ class BlockAllocator:
         self._fifo_order: Dict[int, int] = {}
         self._tick = itertools.count()
         self.num_evictions = 0
+        # Spill hook: invoked with (block, chain_hash) just before a keyed
+        # block's device content is discarded by eviction, while the
+        # content is still valid on device — the KV fabric demotes the
+        # block to its host-DRAM tier here. The allocator stays jax-free:
+        # whoever sets the hook owns the device read. A raising hook is
+        # contained so allocator bookkeeping can never be left torn.
+        self.on_evict: Optional[Callable[[int, int], None]] = None
 
     # ---------------- accounting ----------------
 
@@ -213,9 +220,24 @@ class BlockAllocator:
         h = self._block_hash.pop(b, None)
         if h is not None and self._hash_to_block.get(h) == b:
             del self._hash_to_block[h]
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(b, h)
+                except Exception:
+                    pass  # spill is best-effort; eviction must complete
         self._fifo_order.pop(b, None)
         self.num_evictions += 1
         return b
+
+    def evictable_items(self) -> List[Tuple[int, int]]:
+        """(block, chain_hash) for every keyed refcount-0 block whose
+        device content is still valid — the set a draining engine flushes
+        into the KV fabric before its pool dies with the actor."""
+        return [
+            (b, self._block_hash[b])
+            for b in self._evictable
+            if b in self._block_hash
+        ]
 
     def free(self, blocks: List[int]) -> None:
         # Validate the whole call before mutating anything: a bad id or a

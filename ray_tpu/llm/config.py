@@ -15,6 +15,51 @@ from typing import Any, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
+class KVFabricConfig:
+    """Fleet-wide KV fabric: a shared host-DRAM spill tier for KV blocks.
+
+    One named store actor (`kv_fabric:{name}`) per fabric holds evicted /
+    drained blocks keyed by their content chain hash, bounded by
+    `byte_budget` with its own LRU. Engines pointing at the same `name`
+    share one logical prefix cache: eviction and drain demote blocks to
+    the fabric instead of destroying them, and admission restores fabric
+    hits into freshly allocated device slots.
+    """
+
+    # Fabric identity: engines with the same name share one store actor.
+    name: str = "default"
+    # Host-DRAM byte budget for the store's own LRU. Must hold at least
+    # one block (checked against the actual per-block byte size at engine
+    # construction, where the model dims are known).
+    byte_budget: int = 64 * 1024 * 1024
+    # Prefix-affinity routing: serve.build_app layers a consistent hash on
+    # the prompt's leading block-chain hash onto the router's p2c pick, so
+    # multi-turn sessions land where their cache already lives. Routing
+    # only — the spill/restore tier works either way.
+    affinity: bool = True
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError(
+                "kv_fabric.name must be non-empty — it names the shared "
+                "store actor (kv_fabric:{name}) engines rendezvous on"
+            )
+        if self.byte_budget < 1:
+            raise ValueError(
+                f"kv_fabric.byte_budget must be >= 1 byte, got "
+                f"{self.byte_budget} — a fabric that can hold nothing "
+                "silently degrades every spill to a discard"
+            )
+
+
+# Engine roles for disaggregated prefill/decode. A "prefill" engine runs
+# chunked prefill only, publishes each finished block to the fabric, and
+# finishes the request at its first token; a "decode" engine admits the
+# handed-off request as a pure fabric hit and generates the rest.
+ENGINE_ROLES = ("unified", "prefill", "decode")
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     # Cache layout. Block 0 is reserved as the null/trash block: block
     # tables pad with it, and masked lanes scatter into it.
@@ -136,6 +181,18 @@ class EngineConfig:
     # reduction order differs, so near-tie argmax flips are possible — the
     # same contract as any kernel swap.
     tensor_parallel_size: int = 1
+    # Fleet-wide KV fabric (ray_tpu.llm.kvfabric): None (the default)
+    # disables every fabric hook and leaves all existing paths bit-for-bit
+    # unchanged. A KVFabricConfig turns evictions and drains into demotion
+    # (device pool -> host-DRAM store keyed by chain hash) and extends the
+    # admission prefix match past the device cache into the fabric.
+    kv_fabric: Optional[KVFabricConfig] = None
+    # Disaggregated prefill/decode role: "unified" (default) serves both
+    # phases; "prefill" runs chunked prefill only, publishing finished
+    # blocks to the fabric and completing at the first token; "decode"
+    # expects handed-off requests whose prefix blocks are fabric hits.
+    # Both non-unified roles require kv_fabric.
+    engine_role: str = "unified"
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-
@@ -256,6 +313,31 @@ class EngineConfig:
                 "draft_model_config is only meaningful with "
                 f'speculation="draft" (got speculation={self.speculation!r});'
                 " a silently-ignored draft model is a misconfiguration"
+            )
+        if self.engine_role not in ENGINE_ROLES:
+            raise ValueError(
+                f"engine_role must be one of {ENGINE_ROLES}, got "
+                f"{self.engine_role!r}"
+            )
+        if self.engine_role == "prefill":
+            if self.kv_fabric is None:
+                raise ValueError(
+                    'engine_role="prefill" requires kv_fabric: a prefill '
+                    "engine's only output is the KV blocks it publishes — "
+                    "without a fabric the decode engine can never see them"
+                )
+            if self.prefill_token_budget is None:
+                raise ValueError(
+                    'engine_role="prefill" requires chunked prefill '
+                    "(max_prefill_tokens_per_step must not be 0/None): "
+                    "the prefill role publishes blocks as chunks complete, "
+                    "which is the chunked path's block-aligned contract"
+                )
+        if self.engine_role == "decode" and self.kv_fabric is None:
+            raise ValueError(
+                'engine_role="decode" requires kv_fabric: a decode engine '
+                "admits handed-off requests as fabric hits — without a "
+                "fabric every handoff silently degrades to a full re-prefill"
             )
         from ray_tpu.llm.cache import EVICTION_POLICIES
 

@@ -1,0 +1,192 @@
+"""KV fabric store: host-DRAM tier for spilled KV blocks, as an actor.
+
+One named `KVFabricStore` actor per fabric (`kv_fabric:{name}`) holds the
+device content of demoted blocks — K/V values plus int8 scales, as numpy
+arrays — keyed by the block's content chain hash (llm.cache
+hash_block_tokens). Chain hashes identify whole prefixes, so any engine
+on the fabric can restore a hit into its own freshly allocated slot and
+trust the content: the fleet shares one logical prefix cache.
+
+The store is bounded by a byte budget with its own LRU: a spill that
+would overflow evicts the least-recently-hit entries first, and an entry
+larger than the whole budget is refused outright. Pure numpy + stdlib —
+the actor never touches jax, so it costs no device memory and survives
+any engine's death.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Total bytes of one block payload's arrays (None entries free)."""
+    return sum(
+        a.nbytes for a in payload.values() if hasattr(a, "nbytes")
+    )
+
+
+class KVFabricStore:
+    """Byte-budgeted LRU of block payloads keyed by chain hash."""
+
+    def __init__(self, byte_budget: int):
+        if byte_budget < 1:
+            raise ValueError(
+                f"fabric byte_budget must be >= 1, got {byte_budget}"
+            )
+        self._budget = int(byte_budget)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, dict]" = OrderedDict()
+        self._bytes: Dict[int, int] = {}
+        self._bytes_used = 0
+        self._hits = 0
+        self._misses = 0
+        self._puts = 0
+        self._evictions = 0
+
+    def put(self, block_hash: int, payload: dict) -> bool:
+        """Insert one block payload; True when it is resident afterwards.
+        An already-present hash refreshes recency without rewriting (the
+        content is immutable — equal chain hashes mean equal prefixes).
+        Payloads larger than the whole budget are refused."""
+        nbytes = payload_nbytes(payload)
+        with self._lock:
+            if block_hash in self._entries:
+                self._entries.move_to_end(block_hash)
+                return True
+            if nbytes > self._budget:
+                return False
+            while self._bytes_used + nbytes > self._budget:
+                old_hash, _ = self._entries.popitem(last=False)
+                self._bytes_used -= self._bytes.pop(old_hash)
+                self._evictions += 1
+            self._entries[block_hash] = payload
+            self._bytes[block_hash] = nbytes
+            self._bytes_used += nbytes
+            self._puts += 1
+            return True
+
+    def put_many(self, items: List[tuple]) -> int:
+        """Batch put of [(block_hash, payload), ...]; returns how many are
+        resident afterwards — one RPC for a drain flush."""
+        return sum(1 for h, p in items if self.put(h, p))
+
+    def get(self, block_hash: int) -> Optional[dict]:
+        with self._lock:
+            payload = self._entries.get(block_hash)
+            if payload is None:
+                self._misses += 1
+                return None
+            self._entries.move_to_end(block_hash)
+            self._hits += 1
+            return payload
+
+    def get_many(self, block_hashes: List[int]) -> List[Optional[dict]]:
+        """Batch get, one RPC for a whole restore chain. Order-preserving;
+        misses are None."""
+        return [self.get(h) for h in block_hashes]
+
+    def contains(self, block_hashes: List[int]) -> List[bool]:
+        """Batch membership, WITHOUT touching recency or hit counters —
+        admission probes contains() first and only a restore that actually
+        reads content should count as a hit."""
+        with self._lock:
+            return [h in self._entries for h in block_hashes]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_blocks": len(self._entries),
+                "bytes_used": self._bytes_used,
+                "byte_budget": self._budget,
+                "hits": self._hits,
+                "misses": self._misses,
+                "puts": self._puts,
+                "evictions": self._evictions,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes.clear()
+            self._bytes_used = 0
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def get_or_create_fabric_actor(name: str, byte_budget: int):
+    """The fabric's shared store actor, named `kv_fabric:{name}` so every
+    engine (and every ingress replica's engine) on the same fabric name
+    rendezvouses on one store. First creation pins the byte budget."""
+    return (
+        ray_tpu.remote(KVFabricStore)
+        .options(
+            name=f"kv_fabric:{name}",
+            get_if_exists=True,
+            max_concurrency=16,
+        )
+        .remote(byte_budget)
+    )
+
+
+class KVFabricClient:
+    """Engine-side client: thin, synchronous wrapper over the store actor.
+
+    Every method degrades to a miss/no-op when the store actor is gone
+    (fleet teardown racing an engine's last steps) — the fabric is an
+    accelerator, never a correctness dependency."""
+
+    def __init__(self, name: str, byte_budget: int):
+        self.name = name
+        self._actor = get_or_create_fabric_actor(name, byte_budget)
+
+    def put(self, block_hash: int, payload: dict) -> bool:
+        try:
+            return bool(
+                ray_tpu.get(
+                    self._actor.put.remote(block_hash, payload), timeout=5.0
+                )
+            )
+        except Exception:
+            return False
+
+    def put_many(self, items: List[tuple]) -> int:
+        if not items:
+            return 0
+        try:
+            return int(
+                ray_tpu.get(
+                    self._actor.put_many.remote(items), timeout=30.0
+                )
+            )
+        except Exception:
+            return 0
+
+    def get_many(self, block_hashes: List[int]) -> List[Optional[dict]]:
+        try:
+            return ray_tpu.get(
+                self._actor.get_many.remote(list(block_hashes)), timeout=5.0
+            )
+        except Exception:
+            return [None] * len(block_hashes)
+
+    def contains(self, block_hashes: List[int]) -> List[bool]:
+        if not block_hashes:
+            return []
+        try:
+            return ray_tpu.get(
+                self._actor.contains.remote(list(block_hashes)), timeout=5.0
+            )
+        except Exception:
+            return [False] * len(block_hashes)
+
+    def stats(self) -> dict:
+        try:
+            return ray_tpu.get(self._actor.stats.remote(), timeout=5.0)
+        except Exception:
+            return {}

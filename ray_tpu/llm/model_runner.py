@@ -111,6 +111,9 @@ class _StepPrograms:
         self.copy_block_fn = jax.jit(
             self._copy_block_step, donate_argnums=(0, 1, 2, 3)
         )
+        self.restore_block_fn = jax.jit(
+            self._restore_block_step, donate_argnums=(0, 1, 2, 3)
+        )
 
     # ---------------- traced helpers ----------------
 
@@ -219,6 +222,20 @@ class _StepPrograms:
         next_token = jnp.argmax(logits[0, true_len - 1, :]).astype(jnp.int32)
         pools = self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
         return pools, next_token
+
+    def _restore_block_step(
+        self, k_cache, v_cache, k_scale, v_scale, dst, k, v, ks, vs
+    ):
+        """Write one spilled block's content back into slot `dst` — the KV
+        fabric restore path. A scatter of host payloads, not a new model
+        program: under tensor parallelism the sharding constraint re-pins
+        the pools head-sharded, so a restore can never deshard the cache."""
+        k_cache = k_cache.at[:, dst].set(k)
+        v_cache = v_cache.at[:, dst].set(v)
+        if k_scale is not None:
+            k_scale = k_scale.at[:, dst].set(ks)
+            v_scale = v_scale.at[:, dst].set(vs)
+        return self._constrain_pools((k_cache, v_cache, k_scale, v_scale))
 
     def _copy_block_step(self, k_cache, v_cache, k_scale, v_scale, src, dst):
         k_cache = k_cache.at[:, dst].set(k_cache[:, src])
@@ -482,6 +499,7 @@ class GPTRunner:
         self._prefill_fn = self._programs.prefill_fn
         self._prefill_suffix_fn = self._programs.prefill_suffix_fn
         self._copy_block_fn = self._programs.copy_block_fn
+        self._restore_block_fn = self._programs.restore_block_fn
 
     # ---------------- pool plumbing ----------------
 
@@ -611,6 +629,75 @@ class GPTRunner:
             self._copy_block_fn(*self._pools, jnp.int32(src), jnp.int32(dst))
         )
         self.host_bytes_in += 8  # two int32 block ids
+
+    # ---------------- KV fabric spill / restore ----------------
+
+    def kv_block_bytes(self) -> int:
+        """Bytes of ONE block's payload (K + V values across every layer,
+        plus scale tensors when quantized) — what a single fabric entry
+        costs, and the floor the fabric byte budget is validated against."""
+        cfg, ecfg = self.model_config, self.engine_config
+        slots = cfg.num_layers * ecfg.block_size * cfg.num_heads
+        nbytes = 2 * slots * cfg.head_dim * np.dtype(self.kv_cache_dtype).itemsize
+        if self.quantized:
+            nbytes += 2 * slots * np.dtype(KV_SCALE_DTYPE).itemsize
+        return nbytes
+
+    def extract_block(self, block: int) -> dict:
+        """Read one block's device content to host numpy — the spill half
+        of the fabric tier. The payload is pool-dtype values (+ int8
+        scales), so restore is bit-exact; `kv_dtype` stamps the storage
+        format so a mismatched engine treats the entry as a miss instead
+        of scattering garbage."""
+        payload = {
+            "kv_dtype": self.kv_cache_dtype_str,
+            "k": np.asarray(self.k_cache[:, block]),
+            "v": np.asarray(self.v_cache[:, block]),
+        }
+        if self.quantized:
+            payload["k_scale"] = np.asarray(self.k_scale[:, block])
+            payload["v_scale"] = np.asarray(self.v_scale[:, block])
+        self.host_bytes_out += sum(
+            int(a.nbytes) for a in payload.values() if hasattr(a, "nbytes")
+        )
+        return payload
+
+    def restore_block(self, block: int, payload: dict) -> None:
+        """Write one spilled payload back into slot `block` — the restore
+        half. Raises ValueError on a storage-format mismatch (different
+        kv_cache_dtype or geometry); the caller must then free the slot
+        and treat the chain as a fabric miss."""
+        if payload.get("kv_dtype") != self.kv_cache_dtype_str:
+            raise ValueError(
+                f"fabric payload stored as {payload.get('kv_dtype')!r}, "
+                f"pool is {self.kv_cache_dtype_str!r} — engines on one "
+                "fabric must share kv_cache_dtype"
+            )
+        k, v = payload["k"], payload["v"]
+        expected = self.k_cache.shape[:1] + self.k_cache.shape[2:]
+        if k.shape != expected:
+            raise ValueError(
+                f"fabric payload block shape {k.shape} does not match "
+                f"pool block shape {expected}"
+            )
+        if self.quantized:
+            ks = jnp.asarray(payload["k_scale"])
+            vs = jnp.asarray(payload["v_scale"])
+        else:
+            ks = vs = None
+        self._set_pools(
+            self._restore_block_fn(
+                *self._pools,
+                jnp.int32(block),
+                jnp.asarray(k),
+                jnp.asarray(v),
+                ks,
+                vs,
+            )
+        )
+        self.host_bytes_in += sum(
+            int(a.nbytes) for a in payload.values() if hasattr(a, "nbytes")
+        )
 
     # ---------------- decode / k-token verification ----------------
 

@@ -89,6 +89,13 @@ class Sequence:
         # engine uses it for first-chunk bookkeeping and chunk-indexed
         # observability records.
         self.num_chunks = 0
+        # KV-fabric restore plan: (block, chain_hash) pairs the engine must
+        # copy in from the fabric (allocate happened at admission; the
+        # engine copies in, then registers — in that order) before this
+        # sequence's first prefill chunk. num_cached does NOT cover these
+        # until each restore commits, so a failed restore needs no
+        # rollback: the slot simply stays a plain prefill target.
+        self.pending_restore: List[Tuple[int, int]] = []
 
     @property
     def prefill_ids(self) -> List[int]:
@@ -133,6 +140,11 @@ class Scheduler:
         # restarts its queue-wait clock). Fires only on preemption, so the
         # steady-state decode path pays nothing for it.
         self.on_preempt = None
+        # KV-fabric probe: called with the chain hashes past the device
+        # match, returns per-hash membership in the fabric's host tier
+        # (KVFabricClient.contains). None (the default) keeps admission
+        # exactly the pre-fabric device-only path.
+        self.fabric_probe = None
 
     # ---------------- queue management ----------------
 
@@ -261,6 +273,7 @@ class Scheduler:
             seq.block_table = self.allocator.allocate(total)
             seq.block_hashes = []
             seq.num_cached = 0
+            seq.pending_restore = []
             return True
         hashes = prefix_block_hashes(ids, bs)
         matched = self.allocator.match_prefix(hashes)
@@ -270,6 +283,21 @@ class Scheduler:
         # immutable) block: copy-on-write it.
         cow = k > 0 and k * bs == n
         need = total - k + (1 if cow else 0)
+        # KV fabric: extend the prefix match past the device cache into
+        # the host tier. Restored blocks land in freshly allocated slots
+        # (the leading blocks of `tail` below), capped so at least the
+        # final token stays uncached — full fabric coverage would need the
+        # CoW machinery against a block that doesn't exist on device yet,
+        # and recomputing one trailing block is cheaper than growing a
+        # second CoW path.
+        f = 0
+        if self.fabric_probe is not None and not cow:
+            max_restorable = (n - 1) // bs
+            if k < max_restorable:
+                for hit in self.fabric_probe(hashes[k:max_restorable]):
+                    if not hit:
+                        break
+                    f += 1
         # Shield the matched prefix from being evicted by the tail
         # allocation below (and from anyone else while this seq runs).
         # ray-tpu: lint-ignore[RTL404] nothing between touch and the
@@ -281,6 +309,7 @@ class Scheduler:
             return False
         tail = self.allocator.allocate(need)
         seq.block_hashes = hashes[:k]
+        seq.pending_restore = list(zip(tail[:f], hashes[k : k + f]))
         if cow:
             src, dst = matched[-1], tail[0]
             seq.block_table = matched[:-1] + [dst]
@@ -437,3 +466,4 @@ class Scheduler:
         seq.block_table = []
         seq.block_hashes = []
         seq.num_cached = 0
+        seq.pending_restore = []

@@ -108,7 +108,18 @@ class LLMIngress:
         params=None,
         seed: int = 0,
         draft_params=None,
+        engine_per_replica: bool = False,
     ):
+        # engine_per_replica gives THIS replica its own engine actor
+        # (unique name suffix — each replica's __init__ runs in its own
+        # replica actor) instead of the one shared named engine. That
+        # trades weight duplication for replica-local KV caches, which is
+        # the configuration where the KV fabric earns its keep: replicas
+        # share prefixes through the fabric's host tier + prefix-affinity
+        # routing rather than through one engine's device cache.
+        self._owns_engine = bool(engine_per_replica)
+        if self._owns_engine:
+            engine_name = f"{engine_name}-{uuid.uuid4().hex[:8]}"
         self._engine = get_or_create_engine_actor(
             engine_name, model_config, engine_config, params=params,
             seed=seed, draft_params=draft_params,
@@ -233,6 +244,31 @@ class LLMIngress:
         swapping served params, whose cached activations would be stale)."""
         ray_tpu.get(self._engine.reset_prefix_cache.remote())
 
+    def shutdown(self) -> None:
+        """Drain-path teardown (ReplicaActor.prepare_for_shutdown calls
+        this on the DRAINING→STOPPED transition, after in-flight requests
+        finished): when this replica OWNS its engine, flush the engine's
+        evictable keyed blocks into the KV fabric — the drained replica's
+        reusable prefixes survive as fabric entries a surviving replica
+        can restore, instead of dying with the engine actor — then stop
+        the engine. A shared engine outlives the replica, so there is
+        nothing to flush or stop. Every step is best-effort: shutdown
+        must complete even with the fabric or engine already gone."""
+        if not self._owns_engine:
+            return
+        try:
+            ray_tpu.get(self._engine.flush_kv_fabric.remote(), timeout=30.0)
+        except Exception:
+            pass
+        try:
+            ray_tpu.get(self._engine.shutdown.remote(), timeout=10.0)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(self._engine)
+        except Exception:
+            pass
+
     def check_health(self) -> bool:
         """Replica health forwards to the engine, but a busy engine (e.g.
         compiling a new bucket) must read as healthy — the controller's probe
@@ -273,6 +309,7 @@ def build_app(
     draft_params=None,
     autoscaling_config: Any = None,
     graceful_shutdown_timeout_s: Optional[float] = None,
+    engine_per_replica: bool = False,
 ) -> serve.Application:
     """Bind the LLM ingress for `serve.run` (HTTP via the existing proxy:
     POST /<app> with the request JSON). Pass trained weights via `params`;
@@ -318,7 +355,23 @@ def build_app(
     # surviving replicas — HTTP clients survive drains/kills too, without
     # opting in per handle.
     deployment = deployment.options(stream_resume_fn=llm_stream_resume)
+    if (
+        engine_config is not None
+        and engine_config.kv_fabric is not None
+        and engine_config.kv_fabric.affinity
+    ):
+        # Prefix-affinity routing rides the same declared-on-deployment
+        # path as stream resume: every handle built from the app's config
+        # prefers the rendezvous replica for the prompt's leading
+        # block-chain hash, so multi-turn sessions land where their KV
+        # cache (device tier or fabric tier) already lives. Strictly a
+        # tie-break — drain/exclusion/capacity still decide first.
+        from ray_tpu.llm.kvfabric.affinity import LLMPrefixAffinity
+
+        deployment = deployment.options(
+            affinity_key_fn=LLMPrefixAffinity(engine_config.block_size)
+        )
     return deployment.bind(
         engine_name, model_config, engine_config, params=params, seed=seed,
-        draft_params=draft_params,
+        draft_params=draft_params, engine_per_replica=engine_per_replica,
     )

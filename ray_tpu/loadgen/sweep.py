@@ -345,6 +345,242 @@ def run_drain_cell(
         serve.shutdown()
 
 
+def run_kv_fabric_cell(
+    affinity: bool,
+    rate: float,
+    num_requests: int,
+    seed: int,
+    timeout_s: float = 30.0,
+) -> dict:
+    """The KV-fabric locality cell: two ingress replicas, EACH with its
+    own engine (engine_per_replica), sharing one fabric — run twice by
+    the sweep, prefix-affinity routing on vs off, over the multiturn
+    scenario (sessions whose turn t+1 prompt extends turn t's).
+
+    After the open-loop window the cell demotes every replica's cache to
+    the fabric (the drain-path demotion, minus the drain), replays each
+    session's final prompt through the router (client-timed — the
+    affinity-on row shows the repeat landing on its session's device
+    cache), and then serves one session's final prompt DIRECTLY on BOTH
+    engines: at least one of the two never prefilled that whole prefix,
+    so its blocks can only arrive through the fabric's host tier — the
+    deterministic cross-replica hit the gate asserts. Zero dropped
+    requests is gated like every cell."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.llm.config import EngineConfig, KVFabricConfig
+    from ray_tpu.llm.serve import build_app
+    from ray_tpu.loadgen import report as report_mod
+    from ray_tpu.loadgen.arrivals import ArrivalSpec, arrival_times
+    from ray_tpu.loadgen.driver import run_open_loop
+    from ray_tpu.loadgen.scenarios import ScenarioSpec, generate_requests
+    from ray_tpu.loadgen.slo import IMPOSSIBLE_SLO, LOOSE_SLO, evaluate_slo
+
+    label = "kv_fabric_affinity" if affinity else "kv_fabric_p2c"
+    ecfg = EngineConfig(
+        **BASE_ENGINE,
+        kv_fabric=KVFabricConfig(
+            name=f"{label}-r{rate:g}-s{seed}",
+            byte_budget=64 << 20,
+            affinity=affinity,
+        ),
+    )
+    engine_name = f"loadgen-{label}-r{rate:g}-s{seed}"
+    app_name = f"lg-{label}-r{rate:g}"
+    handle = serve.run(
+        build_app(
+            serve_model_config(),
+            ecfg,
+            engine_name=engine_name,
+            num_replicas=2,
+            engine_per_replica=True,
+            max_concurrent_queries=64,
+        ),
+        name=app_name,
+        _blocking_timeout_s=300.0,
+    )
+    engine_prefix = f"llm_engine:{engine_name}-"
+
+    def _engines() -> dict:
+        out = {}
+        for rec in get_runtime().controller.list_actors():
+            name = getattr(rec, "name", None)
+            if (
+                name
+                and name.startswith(engine_prefix)
+                and rec.state.value == "ALIVE"
+            ):
+                out[name] = ray_tpu.get_actor(name)
+        return out
+
+    try:
+        handle.remote(
+            {"prompt_ids": [1, 2, 3], "max_new_tokens": 2}
+        ).result(timeout_s=300.0)
+
+        spec = ScenarioSpec.for_engine(
+            ecfg.max_model_len,
+            ecfg.buckets()[-1],
+            vocab_size=128,
+            name="multiturn",
+            num_requests=num_requests,
+            seed=seed,
+        )
+        requests = generate_requests(spec)
+        offsets = arrival_times(
+            ArrivalSpec(process="uniform", rate=rate, seed=seed),
+            len(requests),
+        )
+        result = run_open_loop(
+            handle,
+            requests,
+            offsets,
+            timeout_s=timeout_s,
+            settle_timeout_s=max(timeout_s * 2, 60.0),
+        )
+        rep = report_mod.build_report(result)
+
+        engines = _engines()
+        # Settle both engines (the shared-handle _drain_engine only sees
+        # one replica's engine), then demote every cache to the fabric.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            stats = [
+                ray_tpu.get(h.metrics.remote(), timeout=30.0)
+                for h in engines.values()
+            ]
+            if all(
+                s.get("queue_depth", 0) == 0
+                and s.get("num_running", 0) == 0
+                for s in stats
+            ):
+                break
+            time.sleep(0.25)
+        mid = {
+            n: ray_tpu.get(h.metrics.remote(), timeout=30.0)
+            for n, h in engines.items()
+        }
+        flushed = sum(
+            ray_tpu.get(
+                [h.flush_kv_fabric.remote() for h in engines.values()],
+                timeout=60.0,
+            )
+        )
+
+        # Per-session final prompts, in schedule order.
+        finals = {}
+        for r in requests:
+            if r.scenario == "multiturn" and r.session_id is not None:
+                finals[r.session_id] = list(r.prompt_ids)
+
+        # Repeat wave through the router: the client-visible price of a
+        # session resuming after its cache left the device tier.
+        wave = []
+        for prompt in finals.values():
+            t0 = time.perf_counter()
+            handle.remote(
+                {"prompt_ids": prompt, "max_new_tokens": 2}
+            ).result(timeout_s=60.0)
+            wave.append(time.perf_counter() - t0)
+        wave_p50 = sorted(wave)[len(wave) // 2] if wave else None
+
+        # The deterministic cross-replica hit: one session's final
+        # prompt served directly on each engine. Whichever engine did
+        # not prefill that session's last turn is missing at least one
+        # full block on device (a turn adds more than a block of
+        # tokens), and after the flush the fabric holds it.
+        probe = next(iter(finals.values()))
+        for h in engines.values():
+            ray_tpu.get(h.generate.remote(probe, 2, None), timeout=60.0)
+        after = {
+            n: ray_tpu.get(h.metrics.remote(), timeout=30.0)
+            for n, h in engines.items()
+        }
+        cross_replica_hit_blocks = sum(
+            after[n]["fabric_restore_blocks"]
+            - mid[n]["fabric_restore_blocks"]
+            for n in after
+        )
+
+        verdicts = {
+            s.name: evaluate_slo(s, rep)
+            for s in (LOOSE_SLO, IMPOSSIBLE_SLO)
+        }
+        store = next(iter(after.values()))["fabric_store"]
+        return {
+            "config": label,
+            "knobs": {
+                "kv_fabric": True,
+                "affinity": affinity,
+                "engine_per_replica": True,
+                "num_replicas": 2,
+            },
+            "cpu_parity_only": False,
+            "rate": rate,
+            "report": rep,
+            "slo": verdicts,
+            "fabric": {
+                "flushed_blocks": flushed,
+                "cross_replica_hit_blocks": cross_replica_hit_blocks,
+                "repeat_wave_ttft_p50_s": wave_p50,
+                "store": store,
+                "per_engine": {
+                    n: {
+                        "fabric_spill_blocks": s["fabric_spill_blocks"],
+                        "fabric_restore_blocks": s["fabric_restore_blocks"],
+                        "fabric_hit_blocks": s["fabric_hit_blocks"],
+                        "fabric_hit_rate": s["fabric_hit_rate"],
+                        "prefix_cache_hit_rate": s["prefix_cache_hit_rate"],
+                    }
+                    for n, s in after.items()
+                },
+            },
+            "engine": {
+                "wedged": any(s.get("wedged") for s in after.values()),
+                "dead_letters": sum(
+                    s.get("num_dead_letters", 0) for s in after.values()
+                ),
+            },
+        }
+    finally:
+        for h in _engines().values():
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass  # replica teardown already reaped it
+        serve.shutdown()
+
+
+def _gate_kv_fabric(cell: dict) -> List[str]:
+    """Hard assertions for the fabric cells: zero dropped requests, the
+    SLO gate pair still discriminates, no wedge, blocks actually demoted
+    to the host tier, and at least one cross-replica fabric hit — a KV
+    block prefilled by one replica served a request on the other."""
+    tag = f"{cell['config']}@{cell['rate']}"
+    problems = []
+    if cell["report"]["num_errors"] != 0:
+        problems.append(
+            f"{tag}: {cell['report']['num_errors']} dropped requests "
+            f"({cell['report']['errors']})"
+        )
+    if not cell["slo"]["loose"]["passed"]:
+        problems.append(f"{tag}: loose SLO failed")
+    if cell["slo"]["impossible"]["passed"]:
+        problems.append(f"{tag}: impossible SLO passed")
+    if cell["engine"].get("wedged"):
+        problems.append(f"{tag}: engine wedged")
+    fabric = cell["fabric"]
+    if fabric["flushed_blocks"] <= 0:
+        problems.append(f"{tag}: flush demoted no blocks to the fabric")
+    if fabric["cross_replica_hit_blocks"] <= 0:
+        problems.append(
+            f"{tag}: no cross-replica fabric hit (restore delta "
+            f"{fabric['cross_replica_hit_blocks']})"
+        )
+    return problems
+
+
 def _await_drain_settled(
     app_name: str, timeout_s: float = 30.0
 ) -> dict:
@@ -529,6 +765,27 @@ def run_sweep(
         f"{drain_cell['drain'].get('num_migrated_requests')} stream(s)"
         + (f"  !! {drain_problems}" if drain_problems else "")
     )
+    # The KV-fabric locality pair: multiturn over 2 per-replica engines
+    # sharing one fabric, prefix-affinity routing on vs off — gated on
+    # zero drops + at least one cross-replica fabric hit, on every sweep
+    # (quick included).
+    for affinity in (True, False):
+        cell = run_kv_fabric_cell(
+            affinity, rates[0], max(num_requests // 2, 12), seed
+        )
+        cells.append(cell)
+        cell_problems = _gate_kv_fabric(cell)
+        problems.extend(cell_problems)
+        fab = cell["fabric"]
+        wave = fab["repeat_wave_ttft_p50_s"]
+        print(
+            f"[{record_name}] {cell['config']} @ {rates[0]:g}/s: "
+            f"errors {cell['report']['num_errors']}, "
+            f"cross-replica hits {fab['cross_replica_hit_blocks']} "
+            f"blocks, flushed {fab['flushed_blocks']}, repeat p50 "
+            f"{wave if wave is None else round(wave, 4)}s"
+            + (f"  !! {cell_problems}" if cell_problems else "")
+        )
     scenario = _build_scenario(num_requests, seed)
     record = {
         "record": record_name,
@@ -541,7 +798,11 @@ def run_sweep(
             "mode: parity exercise only, never a speedup claim. The "
             "drain_scale_down cell fires a mid-run scale-down and gates "
             "on zero dropped requests + pools drained + exactly one "
-            "replica DRAINING -> STOPPED."
+            "replica DRAINING -> STOPPED. The kv_fabric_affinity / "
+            "kv_fabric_p2c pair runs multiturn over two per-replica "
+            "engines sharing one KV fabric (prefix-affinity routing on "
+            "vs off), gated on zero drops + at least one cross-replica "
+            "fabric hit."
         ),
         "engine_base": dict(BASE_ENGINE),
         "scenario": scenario.to_dict(),

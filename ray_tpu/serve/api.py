@@ -62,6 +62,7 @@ class Deployment:
         request_retry_budget: Optional[int] = None,
         request_backoff_initial_s: Optional[float] = None,
         stream_resume_fn: Optional[Callable] = None,
+        affinity_key_fn: Optional[Callable] = None,
     ) -> "Deployment":
         cfg = replace(self._config)
         if num_replicas is not None:
@@ -86,6 +87,8 @@ class Deployment:
             cfg.request_backoff_initial_s = request_backoff_initial_s
         if stream_resume_fn is not None:
             cfg.stream_resume_fn = stream_resume_fn
+        if affinity_key_fn is not None:
+            cfg.affinity_key_fn = affinity_key_fn
         return Deployment(self._callable_def, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -191,6 +194,7 @@ def run(
         retry_budget=ingress._config.request_retry_budget,
         backoff_initial_s=ingress._config.request_backoff_initial_s,
         stream_resume_fn=ingress._config.stream_resume_fn,
+        affinity_key_fn=ingress._config.affinity_key_fn,
     )
 
 
@@ -283,8 +287,10 @@ def _handle_with_configured_knobs(
         backoff_initial_s=cfg.request_backoff_initial_s,
         # The deployment-declared mid-stream failover policy rides every
         # configured handle — including the HTTP proxy's — so streams
-        # migrate off dying/draining replicas for HTTP clients too.
+        # migrate off dying/draining replicas for HTTP clients too; the
+        # declared affinity policy rides along the same way.
         stream_resume_fn=getattr(cfg, "stream_resume_fn", None),
+        affinity_key_fn=getattr(cfg, "affinity_key_fn", None),
     )
 
 

@@ -183,6 +183,15 @@ class DeploymentConfig:
     # picklable module-level callable with the stream_resume_fn contract
     # (args, kwargs, items_delivered) -> (args, kwargs) | None.
     stream_resume_fn: Optional[Callable] = None
+    # Deployment-declared replica affinity: handles built from this config
+    # compute `affinity_key_fn(args, kwargs) -> hashable | None` once per
+    # request and prefer the rendezvous-hash replica for that key as a
+    # tie-break over power-of-two-choices (never overriding drain,
+    # exclusion, or capacity). For LLM deployments this is
+    # kvfabric.LLMPrefixAffinity — requests sharing a leading prompt block
+    # land where their KV cache already lives. Must be a picklable
+    # module-level callable (or instance of a module-level class).
+    affinity_key_fn: Optional[Callable] = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

@@ -39,6 +39,7 @@ from ray_tpu.serve.config import (
     DEFAULT_BACKOFF_INITIAL_S,
     DEFAULT_RETRY_BUDGET,
 )
+from ray_tpu.util.consistent_hash import rendezvous_pick as _rendezvous_pick
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter, get_or_create
 
@@ -68,6 +69,7 @@ class _RequestContext:
         "failures",
         "drains",
         "tag",
+        "affinity_key",
     )
 
     def __init__(self, method_name: str, args: tuple, kwargs: dict, model_id: str):
@@ -79,6 +81,11 @@ class _RequestContext:
         self.failures = 0
         self.drains = 0  # planned drain migrations (budget-exempt)
         self.tag: Optional[str] = None  # replica serving the latest attempt
+        # Replica-affinity key (deployment's affinity_key_fn over the
+        # request payload, e.g. the prompt's leading block-chain hash);
+        # None = plain p2c. Computed once at assign() and reused verbatim
+        # across failover re-dispatches.
+        self.affinity_key: Optional[Any] = None
 
 
 class DeploymentResponse:
@@ -452,8 +459,16 @@ class Router:
         multiplexed_model_id: str = "",
         stream: bool = False,
         resume_fn: Optional[Callable] = None,
+        affinity_key_fn: Optional[Callable] = None,
     ):
         ctx = _RequestContext(method_name, args, kwargs, multiplexed_model_id)
+        if affinity_key_fn is not None:
+            # Computed once per request, before the first dispatch; a
+            # failing/opaque extractor degrades to plain p2c routing.
+            try:
+                ctx.affinity_key = affinity_key_fn(args, kwargs)
+            except Exception:
+                ctx.affinity_key = None
         result = self.dispatch(ctx, stream)
         if stream:
             return DeploymentResponseGenerator(
@@ -536,7 +551,9 @@ class Router:
             )
         try:
             tag, handle = self._pick_replica(
-                prefer=prefer, excluded=ctx.excluded
+                prefer=prefer,
+                excluded=ctx.excluded,
+                affinity_key=ctx.affinity_key,
             )
         finally:
             with self._lock:
@@ -595,6 +612,7 @@ class Router:
         timeout_s: float = 30.0,
         prefer: str = None,
         excluded: frozenset = frozenset(),
+        affinity_key=None,
     ):
         # Monotonic deadline: an NTP step while blocked here would stretch
         # or truncate the replica wait arbitrarily (found by lint RTL302).
@@ -614,6 +632,19 @@ class Router:
                     th for th in available if th[0] not in excluded
                 ] or available
                 if candidates:
+                    if prefer is None and affinity_key is not None:
+                        # Prefix/content affinity: rendezvous-hash over the
+                        # live NON-EXCLUDED replica set (not the capacity-
+                        # filtered candidates — a momentary full queue must
+                        # not remap the key), then honored only if that
+                        # replica is an eligible candidate below. Layered
+                        # strictly as a tie-break: drain/exclusion filtered
+                        # first, capacity still decides, p2c is the
+                        # fallback — affinity never overrides any of them.
+                        live = sorted(
+                            t for t in self._replicas if t not in excluded
+                        ) or sorted(self._replicas)
+                        prefer = _rendezvous_pick(affinity_key, live)
                     # Model-affinity: take the preferred replica when it has
                     # capacity (multiplexing cache locality).
                     if prefer is not None:
@@ -687,6 +718,7 @@ class DeploymentHandle:
         backoff_initial_s: Optional[float] = None,
         stream_resume_fn: Optional[Callable] = None,
         _router_cell: Optional[_RouterCell] = None,
+        affinity_key_fn: Optional[Callable] = None,
     ):
         self._app = app
         self._deployment = deployment
@@ -698,6 +730,7 @@ class DeploymentHandle:
         self._retry_budget = retry_budget
         self._backoff_initial_s = backoff_initial_s
         self._stream_resume_fn = stream_resume_fn
+        self._affinity_key_fn = affinity_key_fn
 
     @property
     def _router(self) -> Optional[Router]:
@@ -724,6 +757,7 @@ class DeploymentHandle:
         return self._get_router().assign(
             self._method_name, args, kwargs, self._model_id,
             stream=self._stream, resume_fn=self._stream_resume_fn,
+            affinity_key_fn=self._affinity_key_fn,
         )
 
     def options(
@@ -734,6 +768,7 @@ class DeploymentHandle:
         retry_budget: Optional[int] = None,
         backoff_initial_s: Optional[float] = None,
         stream_resume_fn: Optional[Callable] = None,
+        affinity_key_fn: Optional[Callable] = None,
     ) -> "DeploymentHandle":
         changed_router_cfg = (
             retry_budget is not None or backoff_initial_s is not None
@@ -762,6 +797,9 @@ class DeploymentHandle:
             stream_resume_fn=stream_resume_fn
             if stream_resume_fn is not None
             else self._stream_resume_fn,
+            affinity_key_fn=affinity_key_fn
+            if affinity_key_fn is not None
+            else self._affinity_key_fn,
         )
         return h
 
@@ -784,6 +822,7 @@ class DeploymentHandle:
                 self._retry_budget,
                 self._backoff_initial_s,
                 self._stream_resume_fn,
+                self._affinity_key_fn,
             ),
         )
 
@@ -801,6 +840,7 @@ def _rebuild_handle(
     retry_budget=None,
     backoff_initial_s=None,
     stream_resume_fn=None,
+    affinity_key_fn=None,
 ) -> DeploymentHandle:
     return DeploymentHandle(
         app,
@@ -812,4 +852,5 @@ def _rebuild_handle(
         retry_budget=retry_budget,
         backoff_initial_s=backoff_initial_s,
         stream_resume_fn=stream_resume_fn,
+        affinity_key_fn=affinity_key_fn,
     )

@@ -266,7 +266,19 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             "max_prefill_tokens_per_step per engine step)",
             tag_keys=("engine",),
         ),
+        "fabric_hit_rate": get_or_create(
+            Gauge,
+            "llm_engine_fabric_hit_rate",
+            "Cumulative fabric-restored tokens / prefill tokens",
+            tag_keys=("engine",),
+        ),
     }
+    fabric_bytes = get_or_create(
+        Gauge,
+        "llm_engine_fabric_bytes_used",
+        "Bytes resident in the engine's KV fabric store",
+        tag_keys=("engine",),
+    )
     dead_letters = get_or_create(
         Gauge,
         "llm_engine_dead_letters",
@@ -310,7 +322,22 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
                     # the engine, which only registers spec series when
                     # a proposer is configured.
                     continue
+                if (
+                    key == "fabric_hit_rate"
+                    and stats.get("kv_fabric", "off") == "off"
+                ):
+                    # Same disabled-vs-zero distinction as speculation:
+                    # the engine only registers fabric series when a
+                    # kv_fabric is configured.
+                    continue
                 gauge.set(float(stats[key]), tags=tags)
+            fabric_store = stats.get("fabric_store")
+            if stats.get("kv_fabric", "off") != "off" and isinstance(
+                fabric_store, dict
+            ):
+                fabric_bytes.set(
+                    float(fabric_store.get("bytes_used", 0)), tags=tags
+                )
             dead_letters.set(float(stats.get("num_dead_letters", 0)), tags=tags)
             wedged.set(1.0 if stats.get("wedged") else 0.0, tags=tags)
         except Exception:

@@ -1,0 +1,542 @@
+"""Fleet-wide KV fabric: host-DRAM spill tier, disaggregated
+prefill/decode, and prefix-affinity routing (ISSUE 16).
+
+Acceptance pinned here:
+  * the store's byte-budgeted LRU (oversized refusal, recency on get but
+    NOT on contains, order-preserving batch get);
+  * spill/restore byte-exactness — a block extracted from the device
+    pool and restored into a DIFFERENT slot reads back bit-identical,
+    for bf16 and int8+scales pools and on a tp=2 head-sharded mesh;
+  * greedy token-identity with the fabric on vs off across the feature
+    matrix (prefix cache, CoW, chunked prefill, ngram speculation, int8
+    KV, tp=2), and `kv_fabric=None` leaving every hook dark;
+  * eviction demotes to the fabric and a COLD engine on the same fabric
+    restores the blocks as prefix hits, token-identical;
+  * disaggregated prefill/decode token-identical to a unified engine;
+  * fail-fast config validation with specific messages (roles, budget
+    floors, engine-side budget-vs-block-bytes);
+  * observability: fabric counters in stats()/metrics() and the flight
+    record;
+  * serve-level: prefix affinity routes repeat sessions to the same
+    replica, and a drained replica's cache survives through the fabric
+    (the post-drain repeat is a fabric hit, not a re-prefill).
+"""
+
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.llm import (
+    EngineConfig,
+    KVFabricConfig,
+    LLMEngine,
+    LLMServer,
+    hash_block_tokens,
+)
+from ray_tpu.llm.kvfabric import (
+    DisaggregatedLLM,
+    KVFabricStore,
+    LLMPrefixAffinity,
+    leading_block_hash,
+    rendezvous_pick,
+)
+from ray_tpu.models.gpt import GPT, GPTConfig
+
+TINY = GPTConfig(
+    vocab_size=128,
+    num_layers=2,
+    num_heads=4,
+    embed_dim=64,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+# One layer for the on/off matrix: fabric semantics are per-block and
+# layer-invariant; the multi-layer pool indexing is pinned by the
+# byte-exactness tests on the 2-layer model above.
+TINY1 = GPTConfig(
+    vocab_size=64,
+    num_layers=1,
+    num_heads=4,
+    embed_dim=32,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+BASE = dict(
+    block_size=4,
+    num_blocks=16,
+    max_decode_slots=4,
+    max_blocks_per_seq=8,
+    prefill_buckets=(8, 32),
+)
+
+
+def random_prompts(lengths, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, vocab, size=n))) for n in lengths]
+
+
+def reference_greedy(model, params, prompt, n_tokens, pad_to=64):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        padded = np.zeros((1, pad_to), np.int32)
+        padded[0, : len(toks)] = toks
+        logits = model.apply(params, jnp.asarray(padded))
+        t = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def _payload(nbytes: int, fill: int = 0) -> dict:
+    return {"k": np.full(nbytes, fill, np.uint8)}
+
+
+@pytest.fixture
+def ray_fixture():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def serve_ray():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ---------------- store: byte-budgeted LRU ----------------
+
+
+def test_store_lru_eviction_order_and_budget():
+    store = KVFabricStore(byte_budget=100)
+    assert store.put(1, _payload(40))
+    assert store.put(2, _payload(40))
+    # Touch 1: it becomes most-recent, so the next overflow evicts 2.
+    assert store.get(1) is not None
+    assert store.put(3, _payload(40))
+    assert store.contains([1, 2, 3]) == [True, False, True]
+    s = store.stats()
+    assert s["evictions"] == 1 and s["bytes_used"] == 80
+    assert s["num_blocks"] == 2
+
+
+def test_store_refuses_oversized_and_repeat_put_refreshes():
+    store = KVFabricStore(byte_budget=100)
+    assert not store.put(9, _payload(101))  # larger than the whole budget
+    assert store.put(1, _payload(60))
+    assert store.put(1, _payload(60))  # repeat: recency refresh, no rewrite
+    assert store.stats()["puts"] == 1
+    assert store.stats()["bytes_used"] == 60
+
+
+def test_store_contains_does_not_touch_recency_or_hit_counters():
+    store = KVFabricStore(byte_budget=100)
+    store.put(1, _payload(40))
+    store.put(2, _payload(40))
+    before = store.stats()
+    assert store.contains([1, 7]) == [True, False]
+    after = store.stats()
+    assert (after["hits"], after["misses"]) == (
+        before["hits"], before["misses"],
+    )
+    # 1 was NOT recency-bumped by contains: it is still the LRU victim.
+    store.put(3, _payload(40))
+    assert store.contains([1, 2, 3]) == [False, True, True]
+
+
+def test_store_get_many_order_preserving_with_none_misses():
+    store = KVFabricStore(byte_budget=100)
+    store.put(5, _payload(10, fill=5))
+    store.put(7, _payload(10, fill=7))
+    got = store.get_many([7, 99, 5])
+    assert got[1] is None
+    assert got[0]["k"][0] == 7 and got[2]["k"][0] == 5
+
+
+# ---------------- affinity: rendezvous + key extraction ----------------
+
+
+def test_leading_block_hash_matches_chain_hash_and_short_prompt_none():
+    assert leading_block_hash([1, 2], block_size=4) is None
+    assert leading_block_hash([1, 2, 3, 4, 5], block_size=4) == (
+        hash_block_tokens(None, [1, 2, 3, 4])
+    )
+
+
+def test_rendezvous_member_leave_remaps_only_its_keys():
+    tags = [f"replica-{i}" for i in range(4)]
+    keys = list(range(200))
+    before = {k: rendezvous_pick(k, tags) for k in keys}
+    assert len(set(before.values())) == 4  # all members get traffic
+    gone = "replica-2"
+    survivors = [t for t in tags if t != gone]
+    for k in keys:
+        after = rendezvous_pick(k, survivors)
+        if before[k] != gone:
+            # The consistent-hash property a drain depends on: keys not
+            # on the leaver stay put.
+            assert after == before[k]
+        else:
+            assert after in survivors
+    assert rendezvous_pick(1, []) is None
+
+
+def test_prefix_affinity_picklable_stable_and_robust():
+    fn = LLMPrefixAffinity(block_size=4)
+    assert pickle.loads(pickle.dumps(fn)) == fn
+    prompt = [3, 1, 4, 1, 5, 9]
+    key = fn(({"prompt_ids": prompt},), {})
+    assert key == leading_block_hash(prompt, 4)
+    # Same leading block, different tail -> same key (session affinity).
+    assert key == fn(({"prompt_ids": [3, 1, 4, 1, 2, 7, 8]},), {})
+    # Malformed requests degrade to no-affinity, never raise.
+    assert fn((), {}) is None
+    assert fn(("nope",), {}) is None
+    assert fn(({"prompt_ids": [1, 2]},), {}) is None
+
+
+# ---------------- fail-fast config validation ----------------
+
+
+def test_fabric_config_rejects_empty_name_and_zero_budget():
+    with pytest.raises(ValueError, match="name must be non-empty"):
+        KVFabricConfig(name="")
+    with pytest.raises(ValueError, match="byte_budget must be >= 1"):
+        KVFabricConfig(byte_budget=0)
+
+
+def test_prefill_role_requires_fabric_and_chunked_prefill():
+    with pytest.raises(ValueError, match='engine_role="prefill" requires kv_fabric'):
+        EngineConfig(engine_role="prefill")
+    with pytest.raises(ValueError, match="requires chunked prefill"):
+        EngineConfig(
+            engine_role="prefill",
+            kv_fabric=KVFabricConfig(),
+            max_prefill_tokens_per_step=0,
+        )
+
+
+def test_decode_role_requires_fabric():
+    with pytest.raises(ValueError, match='engine_role="decode" requires kv_fabric'):
+        EngineConfig(engine_role="decode")
+    # The valid forms construct fine.
+    EngineConfig(engine_role="decode", kv_fabric=KVFabricConfig())
+    with pytest.raises(ValueError, match="engine_role must be one of"):
+        EngineConfig(engine_role="both")
+
+
+def test_engine_rejects_budget_smaller_than_one_block(ray_fixture):
+    # The per-block byte size needs the model dims, so this check lives
+    # at engine construction — and must round-trip through LLMServer too.
+    cfg = EngineConfig(**BASE, kv_fabric=KVFabricConfig(byte_budget=16))
+    with pytest.raises(ValueError, match="cannot hold a single block"):
+        LLMEngine(TINY, cfg, seed=0)
+    with pytest.raises(ValueError, match="cannot hold a single block"):
+        LLMServer(TINY, cfg, seed=0)
+
+
+# ---------------- spill/restore byte-exactness ----------------
+
+
+def _roundtrip_different_slot(engine):
+    """Extract a cached block, restore it into a DIFFERENT freshly
+    allocated slot, and compare the two extractions bit-for-bit."""
+    items = engine.allocator.evictable_items()
+    assert items, "expected cached blocks after generation"
+    block, _ = items[0]
+    payload = engine.runner.extract_block(block)
+    (other,) = engine.allocator.allocate(1)
+    assert other != block
+    engine.runner.restore_block(other, payload)
+    back = engine.runner.extract_block(other)
+    assert set(back) == set(payload)
+    for key, val in payload.items():
+        if key == "kv_dtype":
+            assert back[key] == val
+            continue
+        assert np.asarray(back[key]).tobytes() == np.asarray(val).tobytes(), (
+            f"{key} not bit-identical across slots"
+        )
+    engine.allocator.free([other])
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_extract_restore_bit_identical_across_slots(kv_dtype):
+    eng = LLMEngine(
+        TINY, EngineConfig(**BASE, kv_cache_dtype=kv_dtype), seed=0
+    )
+    prompts = random_prompts((9, 6), seed=3)
+    out1 = eng.generate(prompts, max_new_tokens=4)
+    _roundtrip_different_slot(eng)
+    if kv_dtype == "int8":
+        payload = eng.runner.extract_block(
+            eng.allocator.evictable_items()[0][0]
+        )
+        assert "k_scale" in payload and "v_scale" in payload
+    # The round-trip itself must not perturb generation.
+    assert eng.generate(prompts, max_new_tokens=4) == out1
+
+
+def test_extract_restore_bit_identical_tp2_head_sharded():
+    eng = LLMEngine(
+        TINY, EngineConfig(**BASE, tensor_parallel_size=2), seed=0
+    )
+    eng.generate(random_prompts((9,), seed=4), max_new_tokens=4)
+    _roundtrip_different_slot(eng)
+
+
+def test_fabric_payload_crosses_engines_byte_exact(ray_fixture):
+    """put/get through the real store actor (serialization boundary)
+    preserves every byte: what engine B restores is exactly what engine A
+    extracted."""
+    from ray_tpu.llm.kvfabric.store import KVFabricClient
+
+    eng = LLMEngine(TINY, EngineConfig(**BASE), seed=0)
+    eng.generate(random_prompts((9,), seed=5), max_new_tokens=4)
+    block, block_hash = eng.allocator.evictable_items()[0]
+    payload = eng.runner.extract_block(block)
+    client = KVFabricClient("exact", byte_budget=8 << 20)
+    assert client.put(block_hash, payload)
+    (got,) = client.get_many([block_hash])
+    for key, val in payload.items():
+        if key == "kv_dtype":
+            assert got[key] == val
+        else:
+            assert np.asarray(got[key]).tobytes() == (
+                np.asarray(val).tobytes()
+            )
+
+
+# ---------------- token identity: fabric on vs off ----------------
+
+MATRIX = {
+    "prefix": {},
+    "chunked": {"max_prefill_tokens_per_step": 8},
+    "spec_ngram": {"speculation": "ngram", "num_speculative_tokens": 3},
+    "int8": {"kv_cache_dtype": "int8"},
+    "tp2": {"tensor_parallel_size": 2},
+}
+
+
+@pytest.mark.parametrize("feature", sorted(MATRIX))
+def test_greedy_identity_fabric_on_vs_off(ray_fixture, feature):
+    """The fabric must be invisible to greedy sampling: same tokens with
+    the spill/restore tier enabled or absent, per feature. The workload
+    repeats its prompts (prefix hits + a fully-cached block-aligned
+    prompt, the CoW shape) so cached paths execute with hooks live."""
+    overrides = MATRIX[feature]
+    prompts = random_prompts((9, 8, 5), vocab=64, seed=6)
+    outs = {}
+    for mode in ("off", "on"):
+        fabric = (
+            None
+            if mode == "off"
+            else KVFabricConfig(name=f"matrix-{feature}", byte_budget=8 << 20)
+        )
+        eng = LLMEngine(
+            TINY1, EngineConfig(**BASE, kv_fabric=fabric, **overrides), seed=0
+        )
+        first = eng.generate(prompts, max_new_tokens=6)
+        again = eng.generate(prompts, max_new_tokens=6)
+        assert first == again, f"{feature}/{mode}: cached repeat diverged"
+        outs[mode] = first
+        assert eng.stats()["prefix_cache_hit_tokens"] > 0
+    assert outs["on"] == outs["off"], f"{feature}: fabric changed tokens"
+
+
+def test_fabric_off_leaves_every_hook_dark():
+    eng = LLMEngine(TINY1, EngineConfig(**BASE), seed=0)
+    assert eng.allocator.on_evict is None
+    assert eng.scheduler.fabric_probe is None
+    stats = eng.stats()
+    assert stats["kv_fabric"] == "off"
+    assert stats["engine_role"] == "unified"
+    assert not stats["fabric_store"]
+
+
+# ---------------- spill tier end to end ----------------
+
+
+def test_eviction_spills_and_cold_engine_restores_as_prefix_hits(ray_fixture):
+    """The tentpole's core loop: engine A's cached blocks demote to the
+    fabric (flush = the drain path's demotion), and a COLD engine B on
+    the same fabric name serves the same prompt with restored blocks
+    counted as prefix-cache hits — token-identical, with the last block
+    recomputed by design (the (n-1)//block_size cap keeps >= 1 token
+    uncached so admission never needs a restore-then-CoW path)."""
+    fabric = KVFabricConfig(name="coldstart", byte_budget=8 << 20)
+    cfg = EngineConfig(**BASE, kv_fabric=fabric)
+    prompt = random_prompts((12,), seed=7)[0]
+
+    a = LLMEngine(TINY, cfg, seed=0)
+    out_a = a.generate([prompt], max_new_tokens=5)[0]
+    flushed = a.flush_kv_fabric()
+    assert flushed >= 3  # 12 prompt tokens -> 3 full blocks cached
+
+    b = LLMEngine(TINY, cfg, seed=0)
+    out_b = b.generate([prompt], max_new_tokens=5)[0]
+    assert out_b == out_a
+    stats = b.stats()
+    max_restorable = (len(prompt) - 1) // cfg.block_size
+    assert stats["fabric_restore_blocks"] == max_restorable
+    assert stats["fabric_hit_blocks"] >= stats["fabric_restore_blocks"]
+    assert stats["fabric_restored_tokens"] == (
+        max_restorable * cfg.block_size
+    )
+    # Restored tokens ARE prefix-cache hits (they skipped recompute).
+    assert stats["prefix_cache_hit_tokens"] >= stats["fabric_restored_tokens"]
+    assert stats["fabric_hit_rate"] > 0
+    assert out_b == reference_greedy(GPT(TINY), b.runner.params, prompt, 5)
+
+
+def test_fabric_observability_counters_and_flight_record(ray_fixture):
+    fabric = KVFabricConfig(name="obs", byte_budget=8 << 20)
+    cfg = EngineConfig(**BASE, kv_fabric=fabric)
+    prompt = random_prompts((12,), seed=8)[0]
+    a = LLMEngine(TINY, cfg, seed=0)
+    a.generate([prompt], max_new_tokens=4)
+    assert a.flush_kv_fabric() > 0
+    assert a.stats()["fabric_spill_blocks"] > 0
+
+    b = LLMEngine(TINY, cfg, seed=0)
+    b.generate([prompt], max_new_tokens=4)
+    stats = b.stats()
+    assert stats["kv_fabric"] == "obs"
+    store = stats["fabric_store"]
+    assert store["bytes_used"] > 0 and store["byte_budget"] == 8 << 20
+    assert store["hits"] >= stats["fabric_restore_blocks"]
+    # The flight record carries per-step restore counts.
+    steps = b.flight_recorder.snapshot()["steps"]
+    assert sum(s.get("fabric_restored_blocks", 0) for s in steps) == (
+        stats["fabric_restore_blocks"]
+    )
+    # The exported metric family includes the fabric series.
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "llm_engine_fabric_restore_blocks" in text
+    assert "llm_engine_fabric_hit_rate" in text
+
+
+# ---------------- disaggregated prefill/decode ----------------
+
+
+def test_disaggregated_prefill_decode_token_identical(ray_fixture):
+    fabric = KVFabricConfig(name="disagg-test", byte_budget=8 << 20)
+    cfg = EngineConfig(**BASE, kv_fabric=fabric)
+    prompts = random_prompts((11, 6), seed=9)
+
+    unified = LLMEngine(TINY, EngineConfig(**BASE), seed=0)
+    want = unified.generate(prompts, max_new_tokens=6)
+
+    disagg = DisaggregatedLLM(TINY, cfg, seed=0, name="disagg-test")
+    try:
+        for prompt, expect in zip(prompts, want):
+            result = disagg.generate(prompt, max_new_tokens=6)
+            assert result["token_ids"] == expect
+        pstats = disagg.prefill_stats()
+        dstats = disagg.decode_stats()
+        assert pstats["engine_role"] == "prefill"
+        assert dstats["engine_role"] == "decode"
+        # The prefill engine published blocks; the decode engine admitted
+        # them as fabric hits (the 11-token prompt restores 2 of its
+        # blocks: (11-1)//4; the 6-token prompt restores 1).
+        assert pstats["fabric_spill_blocks"] >= 3
+        assert dstats["fabric_restore_blocks"] >= 3
+    finally:
+        disagg.shutdown()
+
+
+# ---------------- serve: affinity routing + drain preserves cache ------
+
+
+def test_affinity_routing_and_drain_preserves_cache_via_fabric(serve_ray):
+    """Chaos acceptance: 2 ingress replicas, each with its OWN engine
+    (engine_per_replica) on one fabric. Prefix affinity routes a repeat
+    session to the replica that already holds its KV (device-tier prefix
+    hits on turn 2); scaling to 1 drains a replica, whose shutdown
+    flushes its cache to the fabric; repeating every session post-drain
+    is served token-identically with fabric restores on the survivor —
+    the drained replica's cache survived the drain."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app
+
+    runtime = serve_ray
+    cfg = EngineConfig(
+        block_size=4,
+        num_blocks=12,
+        max_decode_slots=4,
+        max_blocks_per_seq=8,
+        prefill_buckets=(8, 32),
+        kv_fabric=KVFabricConfig(name="serve-drain", byte_budget=8 << 20),
+    )
+    handle = serve.run(
+        build_app(
+            TINY,
+            cfg,
+            engine_name="fabdrain",
+            num_replicas=2,
+            engine_per_replica=True,
+            graceful_shutdown_timeout_s=5.0,
+        ),
+        name="fabdrain",
+    )
+    prompts = random_prompts((10, 10, 10, 10), seed=10)
+
+    def ask(p):
+        return handle.remote(
+            {"prompt_ids": p, "max_new_tokens": 6}
+        ).result(timeout_s=60)["token_ids"]
+
+    want = [ask(p) for p in prompts]
+    # Turn 2: same sessions -> affinity lands them on their replica's
+    # device cache.
+    for p, expect in zip(prompts, want):
+        assert ask(p) == expect
+
+    def live_engines():
+        return [
+            rec.name
+            for rec in runtime.controller.list_actors()
+            if getattr(rec, "name", None)
+            and rec.name.startswith("llm_engine:fabdrain-")
+            and rec.state.value == "ALIVE"
+        ]
+
+    engines = live_engines()
+    assert len(engines) == 2
+    per_engine = {
+        n: ray_tpu.get(ray_tpu.get_actor(n).metrics.remote())
+        for n in engines
+    }
+    assert sum(
+        s["prefix_cache_hit_tokens"] for s in per_engine.values()
+    ) > 0
+
+    serve.scale_deployment("LLMIngress", 1, app_name="fabdrain")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and len(live_engines()) != 1:
+        time.sleep(0.2)
+    (survivor,) = live_engines()
+
+    # Turn 3: every session again. The drained replica's sessions are
+    # only recoverable through the fabric.
+    for p, expect in zip(prompts, want):
+        assert ask(p) == expect
+    stats = ray_tpu.get(ray_tpu.get_actor(survivor).metrics.remote())
+    assert stats["fabric_restore_blocks"] > 0, (
+        "post-drain repeat must be a fabric hit, not a re-prefill"
+    )
+    assert stats["fabric_store"]["bytes_used"] > 0

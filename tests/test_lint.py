@@ -900,6 +900,38 @@ def test_leaky_acquire_flagged_and_try_ok():
     assert len(rtl404) == 1 and rtl404[0].context.endswith("bad")
 
 
+def test_leaky_acquire_kv_fabric_restore_path_fixture():
+    """KV-fabric restore ordering fixture: restore slots come from an
+    allocate() whose failure path frees them, and each slot is committed
+    copy-in (restore_block) FIRST, register AFTER — a half-written block
+    must never become discoverable. The acquire outside any try (bad) is
+    exactly the shape RTL404 exists for: a raise inside the copy-in loop
+    skips the free and leaks every slot in the plan."""
+    findings = lint(
+        """
+        class Engine:
+            def bad(self, plan):
+                tail = self.allocator.allocate(len(plan))
+                for block, h in zip(tail, plan):
+                    self.runner.restore_block(block, self.fabric.get(h))
+                    self.allocator.register(block, h)
+                self.allocator.free(tail)
+
+            def good(self, plan):
+                tail = self.allocator.allocate(len(plan))
+                try:
+                    for block, h in zip(tail, plan):
+                        self.runner.restore_block(block, self.fabric.get(h))
+                        self.allocator.register(block, h)
+                except Exception:
+                    self.allocator.free(tail)
+                    raise
+        """
+    )
+    rtl404 = [f for f in findings if f.rule == "RTL404"]
+    assert len(rtl404) == 1 and rtl404[0].context.endswith("bad")
+
+
 # ---------------------------------------------------------------------------
 # Suppressions + baseline round-trip
 # ---------------------------------------------------------------------------
