@@ -445,6 +445,133 @@ def bench_serving_decode():
     report("serving_decode_vs_sequential_speedup", cont_tps / seq_tps, unit="x")
 
 
+def bench_serving_async_step():
+    """Async double-buffered step loop (EngineConfig.async_scheduling) vs
+    the synchronous dispatch-then-read loop, same engine shape, same
+    varied-length workload.
+
+    The claim the async loop makes is a HOST-GAP claim, not a CPU
+    tokens/sec claim: chaining decode's on-device next_tokens into the
+    next dispatch (values fetched one step behind via copy_to_host_async)
+    removes the host's read-plan-dispatch window from between device
+    programs. That window is what the flight-recorded per-step host_gap_s
+    series measures, so the p50 reduction is asserted on ANY backend —
+    chained dispatches record exactly 0 — while the tokens/sec rows are
+    backend-labeled per the PR 7 convention (on CPU the "device" is the
+    same cores the host plans on, so wall-clock gains are noise-level;
+    the throughput claim is TPU-gated). Token identity off vs on is
+    asserted unconditionally."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm import EngineConfig, LLMEngine
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(
+        vocab_size=512, num_layers=2, num_heads=4, embed_dim=128,
+        max_seq_len=256, dtype=jnp.float32, attention_impl="reference",
+    )
+    rng = np.random.RandomState(0)
+    n_requests = 24
+    prompts = [
+        list(map(int, rng.randint(0, 512, size=rng.randint(4, 25))))
+        for _ in range(n_requests)
+    ]
+    budgets = [int(rng.randint(8, 33)) for _ in range(n_requests)]
+
+    def run(async_on: bool):
+        ecfg = EngineConfig(
+            block_size=8, num_blocks=128, max_decode_slots=8,
+            max_blocks_per_seq=8, async_scheduling=async_on,
+            flight_recorder_capacity=4096,
+        )
+        engine = LLMEngine(cfg, ecfg, seed=0)
+        for n in (5, 9, 17, 33):  # warm every compiled program
+            engine.generate([[1] * n], max_new_tokens=2)
+        engine.allocator.reset_prefix_cache()
+        engine.flight_recorder.steps.clear()
+        produced = []
+
+        def admit(p, b):
+            tokens = []
+            engine.add_request(p, max_new_tokens=b, on_token=tokens.append)
+            produced.append(tokens)
+
+        pending = list(zip(prompts, budgets))
+        t0 = time.perf_counter()
+        while pending or engine.has_work():
+            while pending and len(engine.scheduler.waiting) < 8:
+                admit(*pending.pop(0))
+            engine.step()
+        wall = time.perf_counter() - t0
+        total = sum(len(v) for v in produced)
+        assert total == sum(budgets)
+        steps = engine.flight_recorder.snapshot()["steps"]
+        gaps = sorted(
+            s["host_gap_s"] for s in steps if s.get("host_gap_s") is not None
+        )
+        chained = sum(1 for s in steps if s.get("chained"))
+        dispatches = sum(1 for s in steps if s["dispatch_time"] is not None)
+        stats = engine.stats()
+        assert stats["inflight_steps"] == 0
+        return {
+            "tps": total / wall,
+            "out": produced,
+            "gap_p50": gaps[len(gaps) // 2] if gaps else None,
+            "gap_mean": stats["host_gap_mean_s"],
+            "chained_frac": chained / max(dispatches, 1),
+        }
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    tag = "_cpu" if on_cpu else ""
+    off = run(False)
+    on = run(True)
+    assert on["out"] == off["out"], "async loop changed greedy tokens"
+    assert on["gap_p50"] is not None and off["gap_p50"] is not None
+    # Chained dispatches pin the gap at 0, so with the loop mostly in
+    # steady state the async p50 must land BELOW the sync p50 on any
+    # backend — this is the perf claim the PR gates on.
+    assert on["gap_p50"] < off["gap_p50"], (
+        f"async host-gap p50 {on['gap_p50']} !< sync {off['gap_p50']}"
+    )
+    assert on["chained_frac"] > 0.5, "async loop rarely chained"
+    report(
+        f"serving_async_step_off_tokens_per_s{tag}", off["tps"],
+        unit="tokens/s",
+    )
+    report(
+        f"serving_async_step_on_tokens_per_s{tag}", on["tps"],
+        unit="tokens/s",
+    )
+    report(
+        f"serving_async_step_speedup{tag}", on["tps"] / off["tps"], unit="x"
+    )
+    report(
+        f"serving_async_step_host_gap_p50_off_us{tag}",
+        off["gap_p50"] * 1e6,
+        unit="us",
+    )
+    report(
+        f"serving_async_step_host_gap_p50_on_us{tag}",
+        on["gap_p50"] * 1e6,
+        unit="us",
+    )
+    # Mean-based: the async mean stays nonzero (flush-boundary dispatches
+    # still pay a real gap), so the ratio is finite and trackable; the p50
+    # rows above show the headline (async p50 is exactly 0 once chaining
+    # dominates).
+    report(
+        f"serving_async_step_host_gap_mean_reduction{tag}",
+        off["gap_mean"] / max(on["gap_mean"], 1e-9),
+        unit="x",
+    )
+    # Unlabeled: the chain rate is a property of the loop/workload shape
+    # (flush boundaries), not of the backend.
+    report(
+        "serving_async_step_chained_frac", on["chained_frac"], unit="frac"
+    )
+
+
 def bench_serving_decode_tp():
     """Tensor-parallel serving: one engine spanning a tp=2 mesh vs the
     single-chip tp=1 path, same weights (same seed), same workload.
@@ -1179,6 +1306,7 @@ ALL = [
     ("train_ingestion", bench_train_ingestion),
     ("training_observability", bench_training_observability),
     ("serving_decode", bench_serving_decode),
+    ("serving_async_step", bench_serving_async_step),
     ("serving_decode_tp", bench_serving_decode_tp),
     ("serving_decode_attn_impl", bench_serving_decode_attn_impl),
     ("serving_speculative", bench_serving_speculative),

@@ -107,7 +107,12 @@ function renderLLM(engines){
       `cache ${(m.cache_utilization??0).toFixed(2)} · `+
       `hit rate ${(m.prefix_cache_hit_rate??0).toFixed(2)} · `+
       `queue ${m.queue_depth} · preempt ${m.num_preemptions} · `+
-      `dead letters ${m.num_dead_letters}</p>`+
+      `dead letters ${m.num_dead_letters}`+
+      (m.async_scheduling?` · <b>async</b> host gap `+
+        `${m.host_gap_mean_s==null?'—':(1e6*m.host_gap_mean_s).toFixed(0)+'µs'} mean`+
+        ((e.latency_percentiles?.host_gap_s?.p50)!=null?
+          ` / ${(1e6*e.latency_percentiles.host_gap_s.p50).toFixed(0)}µs p50`:'')+
+        ` · inflight ${m.inflight_steps}`:'')+`</p>`+
       (m.kv_fabric&&m.kv_fabric!=='off'?
         `<p style="font-size:.8rem">kv fabric <b class=mono>${esc(m.kv_fabric)}</b>`+
         (m.engine_role&&m.engine_role!=='unified'?` (${esc(m.engine_role)} role)`:'')+
@@ -117,11 +122,12 @@ function renderLLM(engines){
         `${((m.fabric_store?.byte_budget??0)/1048576).toFixed(1)}MiB `+
         `(${m.fabric_store?.num_blocks??0} blocks, ${m.fabric_store?.evictions??0} evictions)</p>`:'');
     const steps=(fr.steps||[]).slice(-12).map(s=>
-      `<tr><td>${s.step}</td><td>${esc(s.phase)}</td><td>${s.batch_size}</td>`+
+      `<tr><td>${s.step}</td><td>${esc(s.phase)}${s.chained?'⤳':''}</td><td>${s.batch_size}</td>`+
       `<td>${s.tokens_in}/${s.tokens_out}</td><td>${s.cache_hit_tokens}</td>`+
-      `<td>${s.preempted}</td><td>${(1e3*s.duration_s).toFixed(1)}ms</td></tr>`).join('');
+      `<td>${s.preempted}</td><td>${(1e3*s.duration_s).toFixed(1)}ms</td>`+
+      `<td>${s.host_gap_s==null?'—':(1e6*s.host_gap_s).toFixed(0)+'µs'}</td></tr>`).join('');
     const stepTable=steps?`<table><tr><th>step</th><th>phase</th><th>batch</th>`+
-      `<th>tok in/out</th><th>cache hits</th><th>preempt</th><th>dur</th></tr>${steps}</table>`:'';
+      `<th>tok in/out</th><th>cache hits</th><th>preempt</th><th>dur</th><th>gap</th></tr>${steps}</table>`:'';
     const compiles=(fr.compile_events||[]).map(c=>
       `${esc(c.program)}[${c.bucket}] ${c.compile_s.toFixed(2)}s`).join(' · ');
     const fails=(fr.failures||[]).slice(-5).map(f=>
@@ -324,6 +330,7 @@ def _llm_latency_percentiles(engine_id) -> dict:
         ("tpot_s", "llm_request_time_per_output_token_seconds"),
         ("queue_s", "llm_request_queue_time_seconds"),
         ("e2e_s", "llm_request_e2e_seconds"),
+        ("host_gap_s", "llm_engine_step_host_gap_seconds"),
     ):
         try:
             out[label] = {

@@ -193,6 +193,21 @@ class EngineConfig:
     # expects handed-off requests whose prefix blocks are fabric hits.
     # Both non-unified roles require kv_fabric.
     engine_role: str = "unified"
+    # Async double-buffered step loop: split each decode step into a
+    # dispatch phase and a deferred commit phase, pipelined one step deep.
+    # While step N's decode program runs on device, the host plans and
+    # dispatches step N+1 with step N's on-device `next_tokens` chained
+    # directly into N+1's token input (positions/context_lens advance +1
+    # deterministically); an async device->host copy brings N's values
+    # back for emission one step behind. Consequences: EOS/max-token
+    # finishes are detected one step late (the overshoot token is
+    # committed to a scratch position and never emitted), verify/spec
+    # steps and batch-composition changes are pipeline-flush boundaries
+    # (commit-before-plan), and a poisoned decode commit surfaces one
+    # step after its dispatch (failure records attribute against the
+    # dispatch index). Greedy outputs are token-identical either way;
+    # False (the default) keeps the synchronous loop bit-for-bit.
+    async_scheduling: bool = False
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-

@@ -26,7 +26,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -36,6 +36,7 @@ from ray_tpu.llm.cache import BlockAllocator, blocks_for_tokens
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.llm.model_runner import GPTRunner
 from ray_tpu.llm.observability import (
+    HOST_GAP_SECONDS_BOUNDARIES,
     PER_TOKEN_SECONDS_BOUNDARIES,
     REQUEST_SECONDS_BOUNDARIES,
     STEP_SECONDS_BOUNDARIES,
@@ -54,6 +55,33 @@ from ray_tpu.llm.spec import build_proposer
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter, Gauge, Histogram, get_or_create
+
+
+class _InflightStep:
+    """One dispatched-but-uncommitted decode step (async_scheduling).
+
+    Holds everything the deferred commit needs: the batch exactly as it
+    was dispatched (slot order matters — the chained token input is
+    slot-aligned), the on-device `next_tokens` with its async host copy
+    in flight, and the engine step index at dispatch time (failure
+    attribution: a commit-time exception is pinned on the step that
+    DISPATCHED the program, one step before it surfaces). `commit_idx`
+    is the partial-commit resume pointer — after a poison dead-letter
+    mid-commit, the retry resumes the loop exactly where it stopped.
+    """
+
+    __slots__ = (
+        "seqs", "rids", "tokens_dev", "tokens_host",
+        "dispatch_step", "commit_idx",
+    )
+
+    def __init__(self, seqs, rids, tokens_dev, dispatch_step):
+        self.seqs: List[Sequence] = seqs
+        self.rids: List[str] = rids
+        self.tokens_dev = tokens_dev
+        self.tokens_host: Optional[np.ndarray] = None
+        self.dispatch_step = dispatch_step
+        self.commit_idx = 0
 
 
 class LLMEngine:
@@ -296,6 +324,19 @@ class LLMEngine:
             boundaries=STEP_SECONDS_BOUNDARIES,
             tag_keys=("engine", "phase", "attn_impl", "chunk"),
         )
+        self._h_host_gap = get_or_create(
+            Histogram,
+            "llm_engine_step_host_gap_seconds",
+            "Host time between consecutive decode/verify device "
+            "dispatches: how long the previous step's results had been "
+            "sitting on host before the next program was queued — the "
+            "device's scheduling-induced idle window, and the number "
+            "async_scheduling exists to shrink. A chained async dispatch "
+            "issued BEFORE the previous step's results were fetched "
+            "records 0.",
+            boundaries=HOST_GAP_SECONDS_BOUNDARIES,
+            tag_keys=("engine",),
+        )
         # Which paged-attention implementation the runner resolved (pallas
         # fused kernel vs XLA reference): tagged onto the step histograms
         # and per-step flight records so the observability plane can
@@ -370,6 +411,52 @@ class LLMEngine:
         self._spec_accepted_total = 0
         self._spec_emitted_total = 0
         self._verify_steps = 0
+        # Async (double-buffered) step loop state. `_inflight` holds
+        # dispatched-but-uncommitted decode records, oldest first; depth
+        # is transiently 2 between a chained dispatch and the commit of
+        # the record it chained from. Always empty with async off.
+        self._async = self.engine_config.async_scheduling
+        self._inflight: Deque[_InflightStep] = deque()
+        # Dispatch index of the record being committed right now: a
+        # commit-time failure is attributed one step late, against the
+        # step that dispatched the failing program (failure_step()).
+        self._attribution_step: Optional[int] = None
+        # Host-gap apparatus (both loop modes): perf_counter stamp of the
+        # moment the previous decode/verify results became host-readable,
+        # the per-step gap/dispatch/commit fields the flight record
+        # carries, and the cumulative aggregates stats() exposes.
+        self._last_ready_t: Optional[float] = None
+        self._step_gap: Optional[float] = None
+        self._step_dispatch_wall: Optional[float] = None
+        self._step_commits: List[dict] = []
+        self._host_gap_total = 0.0
+        self._host_gap_count = 0
+        self._host_gap_last: Optional[float] = None
+        # Preallocated per-step decode/verify input buffers, zero-filled
+        # and repopulated each dispatch instead of np.zeros-allocated
+        # (the steady decode loop does no numpy allocation at all —
+        # asserted by test). Safe to reuse: the sync runner blocks on the
+        # program before the next fill, and the async runner converts
+        # with a guaranteed copy at dispatch.
+        slots = self.engine_config.max_decode_slots
+        nb = self.engine_config.max_blocks_per_seq
+        self._dec_tokens = np.zeros((slots,), np.int32)
+        self._dec_positions = np.zeros((slots,), np.int32)
+        self._dec_block_tables = np.zeros((slots, nb), np.int32)
+        self._dec_context_lens = np.zeros((slots,), np.int32)
+        self._verify_inputs = (
+            {
+                s: (
+                    np.zeros((slots, s), np.int32),
+                    np.zeros((slots, nb), np.int32),
+                    np.zeros((slots,), np.int32),
+                    np.zeros((slots,), np.int32),
+                )
+                for s in self.engine_config.verify_buckets()
+            }
+            if self._spec is not None
+            else {}
+        )
         self._start = time.monotonic()
 
     # ---------------- request lifecycle ----------------
@@ -453,7 +540,10 @@ class LLMEngine:
         return False
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        # An in-flight async record is work even when the scheduler is
+        # empty (every member aborted mid-flight): one more step drains
+        # it, so callers' step loops never strand a dispatched program.
+        return self.scheduler.has_work() or bool(self._inflight)
 
     # ---------------- poison-request isolation ----------------
 
@@ -466,6 +556,17 @@ class LLMEngine:
         if rid and self.scheduler.is_active(rid):
             return rid
         return None
+
+    def failure_step(self) -> int:
+        """Step index a failure surfacing NOW should be attributed to.
+        Under async_scheduling a decode program's commit runs one step
+        after its dispatch, so an exception raised inside the commit loop
+        belongs to the in-flight record's DISPATCH index (where the
+        failing program and its batch actually ran) — not the current
+        step counter. Outside a commit this is simply the current step."""
+        if self._attribution_step is not None:
+            return self._attribution_step
+        return self._steps
 
     def fail_request(self, request_id: str, exc: BaseException) -> bool:
         """Fail one request in isolation: release its KV blocks, record a
@@ -485,7 +586,7 @@ class LLMEngine:
                 "prompt_len": len(prompt),
                 "tokens_generated": len(seq.generated),
                 "error": repr(exc),
-                "step": self._steps,
+                "step": self.failure_step(),
                 "time": time.time(),
             }
         )
@@ -528,6 +629,8 @@ class LLMEngine:
         never enters the decode batch, so a chunk failure (or a step
         retry) simply re-plans from its committed num_cached; no requeue
         is needed to keep the running set consistent."""
+        if self._async:
+            return self._step_async()
         ecfg = self.engine_config
         preempted_before = self.scheduler.num_preemptions
         step_hit_tokens = 0
@@ -540,6 +643,9 @@ class LLMEngine:
         t_step = time.time() if instrument else 0.0
         t_step_p = time.perf_counter() if instrument else 0.0
         bytes_before = self._host_transfer_bytes() if instrument else 0
+        self._step_gap = None
+        self._step_dispatch_wall = None
+        self._step_commits = []
 
         admitted = self.scheduler.schedule_prefills(
             ecfg.max_prefills_per_step
@@ -569,6 +675,10 @@ class LLMEngine:
                 # the plain decode program is already compiled and exactly
                 # equivalent for one fed token per slot.
                 self._run_decode(decoding)
+        else:
+            # No decode this step: the next dispatch follows an idle
+            # stretch, not host scheduling work — don't count it as gap.
+            self._last_ready_t = None
 
         self._steps += 1
         # A stepping engine exports its whole metric family: counters and
@@ -578,7 +688,7 @@ class LLMEngine:
         family = (
             self._preemptions, self._prefix_hits, self._tokens_generated,
             self._dead_letter_count, self._h_ttft, self._h_tpot,
-            self._h_queue, self._h_e2e, self._h_step,
+            self._h_queue, self._h_e2e, self._h_step, self._h_host_gap,
         )
         if self._spec is not None:
             family = family + (
@@ -657,6 +767,13 @@ class LLMEngine:
                 "queue_depth": len(self.scheduler.waiting),
                 "duration_s": round(time.perf_counter() - t_step_p, 6),
                 "time": t_step,
+                # Dispatch/commit apparatus (sync loop: both halves run
+                # in this step, so commits reference this step's own
+                # dispatch index; host_gap_s is the device idle window
+                # the async loop exists to shrink).
+                "dispatch_time": self._step_dispatch_wall,
+                "commits": self._step_commits,
+                "host_gap_s": self._step_gap,
             }
             if spec_info is not None:
                 # Verify record: which proposer ran, how wide the fed
@@ -770,20 +887,29 @@ class LLMEngine:
         ecfg = self.engine_config
         instrument = self._instrument
         t_decode = time.perf_counter() if instrument else 0.0
-        slots = ecfg.max_decode_slots
-        nb = ecfg.max_blocks_per_seq
-        tokens = np.zeros((slots,), np.int32)
-        positions = np.zeros((slots,), np.int32)
-        block_tables = np.zeros((slots, nb), np.int32)
-        context_lens = np.zeros((slots,), np.int32)
+        # Preallocated input buffers: zero-fill + repopulate, never
+        # allocate. Reuse is safe here because runner.decode blocks on
+        # the program's results before this step returns.
+        tokens = self._dec_tokens
+        positions = self._dec_positions
+        block_tables = self._dec_block_tables
+        context_lens = self._dec_context_lens
+        tokens.fill(0)
+        positions.fill(0)
+        block_tables.fill(0)
+        context_lens.fill(0)
         for i, seq in enumerate(decoding):
             tokens[i] = seq.last_token
             positions[i] = seq.num_cached
             block_tables[i, : len(seq.block_table)] = seq.block_table
             context_lens[i] = seq.num_cached
+        self._note_dispatch(pipelined=False)
         next_tokens = self.runner.decode(
             tokens, positions, block_tables, context_lens
         )
+        # decode() returned == the program ran and its tokens are on
+        # host: everything until the next dispatch is host-side gap.
+        self._last_ready_t = time.perf_counter()
         for i, seq in enumerate(decoding):
             # Per-sequence section; placed before any mutation so a
             # failure here leaves this sequence (and every later one,
@@ -802,6 +928,13 @@ class LLMEngine:
         self._current_rid = None
         self._decode_tokens += len(decoding)
         self._decode_slot_steps += ecfg.max_decode_slots
+        self._step_commits.append(
+            {
+                "dispatch_step": self._steps,
+                "time": time.time(),
+                "tokens": len(decoding),
+            }
+        )
         if instrument:
             # One observation per batched decode dispatch, never per
             # token — the whole emission loop rides in it.
@@ -855,12 +988,15 @@ class LLMEngine:
         if max_fed == 1:
             return None
         s_bucket = ecfg.verify_bucket_for(max_fed)
-        slots = ecfg.max_decode_slots
-        nb = ecfg.max_blocks_per_seq
-        tokens = np.zeros((slots, s_bucket), np.int32)
-        block_tables = np.zeros((slots, nb), np.int32)
-        context_lens = np.zeros((slots,), np.int32)
-        true_lens = np.zeros((slots,), np.int32)
+        # Preallocated per-bucket input buffers (zero-fill + repopulate);
+        # reuse is safe — runner.verify blocks on the program's results.
+        tokens, block_tables, context_lens, true_lens = self._verify_inputs[
+            s_bucket
+        ]
+        tokens.fill(0)
+        block_tables.fill(0)
+        context_lens.fill(0)
+        true_lens.fill(0)
         for i, (seq, props) in enumerate(zip(decoding, plans)):
             tokens[i, 0] = seq.last_token
             if props:
@@ -868,9 +1004,11 @@ class LLMEngine:
             block_tables[i, : len(seq.block_table)] = seq.block_table
             context_lens[i] = seq.num_cached
             true_lens[i] = 1 + len(props)
+        self._note_dispatch(pipelined=False)
         out = self.runner.verify(
             tokens, block_tables, context_lens, true_lens
         )
+        self._last_ready_t = time.perf_counter()
         proposed = accepted = emitted = 0
         for i, (seq, props) in enumerate(zip(decoding, plans)):
             # Per-sequence commit section; nothing mutates before the
@@ -904,7 +1042,14 @@ class LLMEngine:
             self._maybe_finish(seq)
         self._current_rid = None
         self._decode_tokens += emitted
-        self._decode_slot_steps += slots
+        self._decode_slot_steps += ecfg.max_decode_slots
+        self._step_commits.append(
+            {
+                "dispatch_step": self._steps,
+                "time": time.time(),
+                "tokens": emitted,
+            }
+        )
         self._verify_steps += 1
         self._spec_proposed_total += proposed
         self._spec_accepted_total += accepted
@@ -931,6 +1076,383 @@ class LLMEngine:
             "accepted": accepted,
             "emitted": emitted,
         }
+
+    # ---------------- async (double-buffered) stepping ----------------
+
+    def _note_dispatch(self, pipelined: bool) -> None:
+        """Host-gap sample at a decode/verify device dispatch: how long
+        the previous step's results had been host-readable before this
+        program was queued — the device idle window host scheduling
+        opened. A chained async dispatch is issued BEFORE the previous
+        step's results are even fetched, so it records exactly 0 (the
+        gap definition's clamp: the dispatch beat the fetch)."""
+        self._step_dispatch_wall = time.time()
+        if pipelined:
+            gap = 0.0
+        else:
+            if self._last_ready_t is None:
+                return  # first dispatch / post-idle: no previous step
+            gap = max(0.0, time.perf_counter() - self._last_ready_t)
+        self._step_gap = gap
+        self._host_gap_total += gap
+        self._host_gap_count += 1
+        self._host_gap_last = gap
+        self._h_host_gap.observe(gap, tags=self._metric_tags)
+
+    def _step_async(self) -> dict:
+        """One iteration of the async step loop (EngineConfig.
+        async_scheduling): decode splits into a dispatch phase and a
+        deferred commit phase, pipelined one step deep.
+
+        Steady state CHAINS: the in-flight decode's on-device
+        `next_tokens` feed the next dispatch directly (positions and
+        context_lens advance +1 — deterministic, value-free), THEN the
+        in-flight step's values are fetched and committed one step
+        behind, so the device is already running step N+1 while the host
+        emits step N's tokens and plans admissions. Everything
+        value-dependent is a pipeline-flush boundary (commit everything,
+        then schedule normally): speculation (the proposer reads
+        committed token history), any batch-composition change (finish /
+        abort / preemption / a prompt joining — the chained token input
+        is slot-aligned), block pressure the lookahead cannot cover
+        without preempting (preemption must never run under an in-flight
+        write), and a partially committed record left by a poison retry.
+
+        Finishes are detected one step late, at commit: a chained
+        dispatch may decode one token PAST a sequence's EOS/length stop.
+        That overshoot token lands in the null block or a lookahead block
+        freed with the sequence, is skipped at its record's commit, and
+        never reaches a client. Greedy outputs are token-identical to the
+        sync loop across every feature knob."""
+        ecfg = self.engine_config
+        preempted_before = self.scheduler.num_preemptions
+        step_hit_tokens = 0
+        self._current_rid = None
+        maybe_fail("llm.step")
+        instrument = self._instrument
+        t_step = time.time() if instrument else 0.0
+        t_step_p = time.perf_counter() if instrument else 0.0
+        bytes_before = self._host_transfer_bytes() if instrument else 0
+        self._step_gap = None
+        self._step_dispatch_wall = None
+        self._step_commits = []
+
+        # Chained dispatch FIRST — before any commit, admission, or
+        # metric work: the whole point is that the device gets its next
+        # program while the host still owes this step's bookkeeping. A
+        # record mid-partial-commit (poison retry) or a second in-flight
+        # record never chains; both flush below.
+        chained_seqs: Optional[List[Sequence]] = None
+        if (
+            self._spec is None
+            and len(self._inflight) == 1
+            and self._inflight[0].commit_idx == 0
+        ):
+            chained_seqs = self._try_chain(self._inflight[0])
+        if chained_seqs is not None:
+            # Commit the record the chain fed from (its async host copy
+            # has been in flight since its dispatch); the chained record
+            # stays in flight for the next iteration.
+            self._commit_head()
+        else:
+            # Flush boundary: commit everything in dispatch order, then
+            # schedule normally from fully committed state.
+            while self._inflight:
+                self._commit_head()
+
+        admitted = self.scheduler.schedule_prefills(
+            ecfg.max_prefills_per_step
+        )
+        step_restored = 0
+        if self._fabric is not None:
+            step_restored = self._apply_fabric_restores(admitted)
+        plans = self.scheduler.schedule_prefill_chunks(self._prefill_budget)
+        prefill_info: List[dict] = []
+        step_hit_tokens += self._run_prefill_chunks(plans, prefill_info)
+
+        spec_info: Optional[dict] = None
+        dispatched = chained_seqs is not None
+        if chained_seqs is not None:
+            decoding = chained_seqs
+        else:
+            decoding = self.scheduler.schedule_decode()
+            if decoding:
+                if self._spec is not None:
+                    # Speculation composes as flush-every-step: acceptance
+                    # is value-dependent, so the verify path runs the sync
+                    # dispatch+commit inline (still token-identical).
+                    spec_info = self._run_verify(decoding)
+                    if spec_info is None:
+                        self._run_decode(decoding)
+                else:
+                    self._dispatch_decode_async(decoding)
+                    dispatched = True
+            else:
+                self._last_ready_t = None
+
+        self._steps += 1
+        family = (
+            self._preemptions, self._prefix_hits, self._tokens_generated,
+            self._dead_letter_count, self._h_ttft, self._h_tpot,
+            self._h_queue, self._h_e2e, self._h_step, self._h_host_gap,
+        )
+        if self._spec is not None:
+            family = family + (
+                self._spec_proposed, self._spec_accepted,
+                self._spec_acceptance,
+            )
+        if self._fabric is not None:
+            family = family + (
+                self._fabric_spills, self._fabric_restores,
+                self._fabric_hits, self._fabric_hit_rate,
+                self._fabric_bytes_used,
+            )
+        for metric in family:
+            metric._ensure_registered()
+        preempted = self.scheduler.num_preemptions - preempted_before
+        if preempted:
+            self._preemptions.inc(preempted, tags=self._metric_tags)
+        if step_hit_tokens:
+            self._cache_hit_tokens += step_hit_tokens
+            self._prefix_hits.inc(step_hit_tokens, tags=self._metric_tags)
+        occupancy = len(decoding) / ecfg.max_decode_slots
+        self._occupancy.set(occupancy, tags=self._metric_tags)
+        self._cache_util.set(
+            self.allocator.utilization(), tags=self._metric_tags
+        )
+        self._queue_depth.set(
+            len(self.scheduler.waiting), tags=self._metric_tags
+        )
+        self._prefix_hit_rate.set(
+            self._cache_hit_tokens / max(self._prefill_tokens, 1),
+            tags=self._metric_tags,
+        )
+        self._evictable_blocks.set(
+            self.allocator.num_evictable, tags=self._metric_tags
+        )
+        if self._fabric is not None:
+            self._fabric_hit_rate.set(
+                self._fabric_restored_tokens / max(self._prefill_tokens, 1),
+                tags=self._metric_tags,
+            )
+        backlog = self.scheduler.prefill_backlog_tokens()
+        self._prefill_backlog.set(backlog, tags=self._metric_tags)
+        committed_tokens = sum(c["tokens"] for c in self._step_commits)
+        if instrument:
+            decode_label = "verify" if spec_info is not None else "decode"
+            parts = []
+            if plans:
+                parts.append("prefill")
+            if spec_info is not None or (decoding and not dispatched):
+                parts.append(decode_label)
+            elif dispatched:
+                parts.append("decode")
+            elif self._step_commits:
+                # Drain-only iteration: nothing dispatched, but a stale
+                # in-flight record committed (e.g. every member finished
+                # or aborted since its dispatch).
+                parts.append("commit")
+            phase = "+".join(parts) or "idle"
+            record = {
+                "step": self._steps - 1,
+                "loop": "async",
+                "phase": phase,
+                "attn_impl": self._attn_impl,
+                "tensor_parallel_size": self._tp,
+                "host_transfer_bytes": (
+                    self._host_transfer_bytes() - bytes_before
+                ),
+                "batch_size": len(decoding),
+                "num_prefills": len(plans),
+                "prefills": prefill_info,
+                "tokens_in": sum(p["tokens"] for p in prefill_info),
+                "prefill_budget": self._prefill_budget,
+                "prefill_backlog_tokens": backlog,
+                # Async semantics: tokens_out counts tokens COMMITTED
+                # this iteration (prefill finals + deferred decode
+                # commits) — a dispatched-but-uncommitted token is not
+                # out yet.
+                "tokens_out": sum(1 for p in prefill_info if p["final"])
+                + (
+                    spec_info["emitted"]
+                    if spec_info is not None
+                    else committed_tokens
+                ),
+                "cache_hit_tokens": step_hit_tokens,
+                "preempted": preempted,
+                "queue_depth": len(self.scheduler.waiting),
+                "duration_s": round(time.perf_counter() - t_step_p, 6),
+                "time": t_step,
+                "dispatch_time": self._step_dispatch_wall,
+                "commits": self._step_commits,
+                "host_gap_s": self._step_gap,
+                "chained": chained_seqs is not None,
+                "inflight_depth": len(self._inflight),
+            }
+            if spec_info is not None:
+                record["speculation"] = spec_info
+            if self._fabric is not None:
+                record["fabric_restored_blocks"] = step_restored
+            self.flight_recorder.record_step(record)
+        return {
+            "num_prefilled": len(plans),
+            "num_decoding": len(decoding),
+            "occupancy": occupancy,
+            "cache_utilization": self.allocator.utilization(),
+            "queue_depth": len(self.scheduler.waiting),
+            "preempted": preempted,
+            "cache_hit_tokens": step_hit_tokens,
+            "evictable_blocks": self.allocator.num_evictable,
+            "prefill_backlog_tokens": backlog,
+        }
+
+    def _try_chain(self, rec: _InflightStep) -> Optional[List[Sequence]]:
+        """Chain the in-flight decode into the next dispatch if — and
+        only if — the next decode batch would be EXACTLY the dispatched
+        batch (same sequences, same slot order: the chained token input
+        is slot-aligned on device) AND every +1-position write can be
+        covered without preempting anyone (reserve_decode_lookahead).
+        On success the chained program is already dispatched when this
+        returns; on any mismatch returns None and the caller flushes."""
+        for seq, rid in zip(rec.seqs, rec.rids):
+            if (
+                not seq.is_running
+                or seq.prefilling
+                or not self.scheduler.is_active(rid)
+            ):
+                return None
+        current = [s for s in self.scheduler.running if not s.prefilling]
+        if len(current) != len(rec.seqs) or any(
+            a is not b for a, b in zip(current, rec.seqs)
+        ):
+            return None
+        if not self.scheduler.reserve_decode_lookahead(rec.seqs):
+            return None
+        self._dispatch_chained(rec)
+        return rec.seqs
+
+    def _dispatch_chained(self, rec: _InflightStep) -> None:
+        """Dispatch the next decode with the in-flight step's on-device
+        tokens as input — no host sync anywhere on this path. The
+        in-flight token for slot i has not committed yet, so its write
+        position is num_cached + 1 and its context covers num_cached + 1
+        tokens; both advance deterministically without knowing the
+        token's value. Unused slots carry whatever the previous program
+        sampled — they scatter into the null block exactly like the sync
+        path's zero padding."""
+        self._note_dispatch(pipelined=True)
+        positions = self._dec_positions
+        block_tables = self._dec_block_tables
+        context_lens = self._dec_context_lens
+        positions.fill(0)
+        block_tables.fill(0)
+        context_lens.fill(0)
+        for i, seq in enumerate(rec.seqs):
+            positions[i] = seq.num_cached + 1
+            block_tables[i, : len(seq.block_table)] = seq.block_table
+            context_lens[i] = seq.num_cached + 1
+        tokens_dev = self.runner.decode_async(
+            rec.tokens_dev, positions, block_tables, context_lens
+        )
+        self._inflight.append(
+            _InflightStep(rec.seqs, rec.rids, tokens_dev, self._steps)
+        )
+
+    def _dispatch_decode_async(self, decoding: List[Sequence]) -> None:
+        """Fresh async dispatch from fully committed state (pipeline
+        start / after a flush): inputs build exactly like _run_decode,
+        but the runner starts an async device->host copy instead of
+        blocking — the commit runs one step later (_commit_head)."""
+        tokens = self._dec_tokens
+        positions = self._dec_positions
+        block_tables = self._dec_block_tables
+        context_lens = self._dec_context_lens
+        tokens.fill(0)
+        positions.fill(0)
+        block_tables.fill(0)
+        context_lens.fill(0)
+        for i, seq in enumerate(decoding):
+            tokens[i] = seq.last_token
+            positions[i] = seq.num_cached
+            block_tables[i, : len(seq.block_table)] = seq.block_table
+            context_lens[i] = seq.num_cached
+        self._note_dispatch(pipelined=False)
+        tokens_dev = self.runner.decode_async(
+            tokens, positions, block_tables, context_lens
+        )
+        self._inflight.append(
+            _InflightStep(
+                list(decoding),
+                [s.request.request_id for s in decoding],
+                tokens_dev,
+                self._steps,
+            )
+        )
+
+    def _commit_head(self) -> None:
+        """Fetch and commit the OLDEST in-flight record — the deferred
+        half of a dispatch made one iteration ago. The commit loop is the
+        sync path's, one step late: per-sequence poison site, num_cached
+        advance, block publication, emission, finish detection.
+        Sequences that went inactive since dispatch (finished at the
+        previous commit, aborted, preempted on a flush) are skipped —
+        their fetched token is the EOS/length overshoot or an orphan, and
+        it is dropped before any emission. On a mid-loop exception the
+        record stays at the head with commit_idx advanced past the
+        already-committed slots, so the server's step retry resumes the
+        commit exactly where it stopped; failure_step() attributes the
+        exception against this record's DISPATCH index."""
+        rec = self._inflight[0]
+        ecfg = self.engine_config
+        instrument = self._instrument
+        t0 = time.perf_counter() if instrument else 0.0
+        self._attribution_step = rec.dispatch_step
+        if rec.tokens_host is None:
+            # Materialize the async copy (usually already done — it has
+            # been in flight since dispatch). A failed decode PROGRAM
+            # surfaces here, one step after dispatch, attributed above.
+            rec.tokens_host = np.asarray(rec.tokens_dev)
+            self._last_ready_t = time.perf_counter()
+        next_tokens = rec.tokens_host
+        committed = 0
+        while rec.commit_idx < len(rec.seqs):
+            i = rec.commit_idx
+            seq = rec.seqs[i]
+            if (
+                not seq.is_running
+                or seq.prefilling
+                or not self.scheduler.is_active(rec.rids[i])
+            ):
+                rec.commit_idx += 1
+                continue
+            self._current_rid = rec.rids[i]
+            maybe_fail("llm.decode.seq", detail=rec.rids[i])
+            seq.num_cached += 1
+            seq.generated.append(int(next_tokens[i]))
+            if seq.num_cached % ecfg.block_size == 0:
+                self.scheduler.note_filled_blocks(seq)
+            rec.commit_idx += 1
+            committed += 1
+            self._emit(seq)
+            self._maybe_finish(seq)
+        self._current_rid = None
+        self._attribution_step = None
+        self._inflight.popleft()
+        self._decode_tokens += committed
+        self._decode_slot_steps += ecfg.max_decode_slots
+        self._step_commits.append(
+            {
+                "dispatch_step": rec.dispatch_step,
+                "time": time.time(),
+                "tokens": committed,
+            }
+        )
+        if instrument:
+            # The async decode series measures the commit half (fetch +
+            # emission loop) — the dispatch half is what the chain hides.
+            self._h_step.observe(
+                time.perf_counter() - t0, tags=self._step_tags["decode"]
+            )
 
     def _run_prefill_chunks(
         self,
@@ -1197,6 +1719,21 @@ class LLMEngine:
             "host_transfer_bytes": self._host_transfer_bytes(),
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
+            # Async step loop (EngineConfig.async_scheduling) + the
+            # host-gap apparatus it is measured by: mean/last host time
+            # between consecutive device dispatches (0 for a chained
+            # async dispatch — it beat the previous step's fetch), and
+            # how many records are dispatched-but-uncommitted right now.
+            "async_scheduling": self._async,
+            "inflight_steps": len(self._inflight),
+            "host_gap_samples": self._host_gap_count,
+            "host_gap_total_s": self._host_gap_total,
+            "host_gap_mean_s": (
+                self._host_gap_total / self._host_gap_count
+                if self._host_gap_count
+                else None
+            ),
+            "host_gap_last_s": self._host_gap_last,
             "mean_occupancy": (
                 self._decode_tokens / self._decode_slot_steps
                 if self._decode_slot_steps
@@ -1321,11 +1858,19 @@ class LLMServer:
             # (partial prefill), silently skipping the compile — and the
             # publish/spill side would flood the shared store with
             # zero-block entries every replica start.
+            # Async stepping is suppressed during warmup as well: the
+            # generate-based rounds must compile each bucket program in
+            # a deterministic order with deterministic step counts, and
+            # the async loop's chained decode dispatches the SAME
+            # compiled program anyway (identical avals — a device token
+            # array and a host one trace alike), so async mode needs no
+            # warmup pass of its own.
             instrumented = self._engine._instrument
             spec = self._engine._spec
             publish = self._engine._publish_on_fill
             on_evict = self._engine.allocator.on_evict
             probe = self._engine.scheduler.fabric_probe
+            async_loop = self._engine._async
             self._engine._instrument = False
             # ray-tpu: lint-ignore[RTL403] deliberate temporary clear —
             # the finally below restores _spec on every path, so no
@@ -1334,6 +1879,7 @@ class LLMServer:
             self._engine._publish_on_fill = False
             self._engine.allocator.on_evict = None
             self._engine.scheduler.fabric_probe = None
+            self._engine._async = False
             try:
                 self._warmup()
             finally:
@@ -1342,6 +1888,7 @@ class LLMServer:
                 self._engine._publish_on_fill = publish
                 self._engine.allocator.on_evict = on_evict
                 self._engine.scheduler.fabric_probe = probe
+                self._engine._async = async_loop
             if spec is not None:
                 self._warmup_verify(spec)
         self._lock = threading.Lock()
@@ -1496,7 +2043,11 @@ class LLMServer:
                     # isolation entirely).
                     culprit = self._engine.culprit_for(exc)
                     recorder = self._engine.flight_recorder
-                    step_idx = self._engine._steps
+                    # Under async_scheduling a commit-time failure is
+                    # attributed one step late: failure_step() resolves
+                    # to the in-flight record's DISPATCH index (sync
+                    # mode: the current step, as before).
+                    step_idx = self._engine.failure_step()
                     if culprit is not None:
                         # Poison-request isolation: fail only the culpable
                         # request (dead-letter + KV release) and keep

@@ -751,3 +751,47 @@ class GPTRunner:
             (tokens, positions, block_tables, context_lens), next_tokens
         )
         return next_tokens
+
+    def decode_async(
+        self,
+        tokens,
+        positions: np.ndarray,
+        block_tables: np.ndarray,
+        context_lens: np.ndarray,
+    ) -> jax.Array:
+        """Dispatch one batched decode WITHOUT waiting for its result.
+
+        Same compiled program as `decode` (identical avals, so no extra
+        compile), but the sampled tokens stay on device: `tokens` may be
+        the previous step's on-device `next_tokens` (token chaining — it
+        is not donated, so the caller can still fetch it afterwards), and
+        the return value is the device array for THIS step with an async
+        device->host copy already started. The caller materializes the
+        values one step later with `np.asarray` at commit time.
+
+        The host-side numpy inputs are converted with `jnp.array`
+        (guaranteed copy): the engine reuses these buffers across steps,
+        and a zero-copy alias would let next step's buffer fill corrupt a
+        still-running program's inputs.
+        """
+        chained = isinstance(tokens, jax.Array)
+        pools, next_tokens = self._decode_fn(
+            self.params,
+            *self._pools,
+            tokens if chained else jnp.array(tokens, jnp.int32),
+            jnp.array(positions, jnp.int32),
+            jnp.array(block_tables, jnp.int32),
+            jnp.array(context_lens, jnp.int32),
+        )
+        self._set_pools(pools)
+        try:
+            next_tokens.copy_to_host_async()
+        except (AttributeError, NotImplementedError):  # pragma: no cover
+            pass  # backend without async copies: the commit asarray blocks
+        # Chained token inputs never cross the host boundary — that is
+        # part of the win the transfer counters should show.
+        host_in = (positions, block_tables, context_lens)
+        if not chained:
+            host_in = (tokens,) + host_in
+        self._count_transfer(host_in, next_tokens)
+        return next_tokens

@@ -52,6 +52,16 @@ STEP_SECONDS_BOUNDARIES = [
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
     0.5, 1.0, 2.5,
 ]
+# Host gap between consecutive decode dispatches: how long the device sat
+# idle waiting on host scheduling/commit work before the next program was
+# queued. This is the number async_scheduling exists to shrink — a chained
+# dispatch issued before the previous step's results were even fetched
+# records 0, so the ladder starts at 10 µs and the first bucket is the
+# "pipelined" bucket.
+HOST_GAP_SECONDS_BOUNDARIES = [
+    0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+    0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+]
 
 
 class RequestTrace:

@@ -360,6 +360,33 @@ class Scheduler:
                         break
         return [s for s in self.running if not s.prefilling]
 
+    def reserve_decode_lookahead(self, seqs: List[Sequence]) -> bool:
+        """Extend block tables so a CHAINED decode step can run before the
+        in-flight step commits: the chained write lands at position
+        num_cached + 1 (num_cached has not advanced yet — the in-flight
+        token commits it later), needing (num_cached + 1) // bs + 1 blocks
+        per sequence. Unlike schedule_decode this NEVER preempts — with a
+        step in flight, preemption would reset a sequence whose uncommitted
+        token is still on device — and never raises: on pool pressure, a
+        per-sequence table cap, or a sequence whose chained write would
+        fall past max_blocks_per_seq * bs, it allocates nothing and returns
+        False so the engine flushes the pipeline and schedules normally.
+        All-or-nothing: the batch chains together or not at all."""
+        bs = self.allocator.block_size
+        extras: List[Tuple[Sequence, int]] = []
+        for seq in seqs:
+            needed = (seq.num_cached + 1) // bs + 1
+            if needed > self.max_blocks_per_seq:
+                return False
+            extras.append((seq, max(0, needed - len(seq.block_table))))
+        total = sum(extra for _, extra in extras)
+        if total and not self.allocator.can_allocate(total):
+            return False
+        for seq, extra in extras:
+            if extra:
+                seq.block_table.extend(self.allocator.allocate(extra))
+        return True
+
     def reserve_speculative(self, seq: Sequence, num_tokens: int) -> int:
         """Extend `seq`'s block table so a verify step can write K/V for
         its next token PLUS up to `num_tokens` speculative tokens

@@ -54,6 +54,9 @@ KNOB_CONFIGS: Tuple[Tuple[str, dict, bool], ...] = (
         False,
     ),
     ("int8_kv", {"kv_cache_dtype": "int8"}, False),
+    # Async double-buffered step loop: dispatch N+1 while N's values are
+    # still in flight; token-identical to base, host gap ~0 when chained.
+    ("async_step", {"async_scheduling": True}, False),
     # Fused kernel on CPU = interpret mode: parity/latency-shape exercise
     # only, never a speedup claim (PR 7 convention).
     ("pallas_interpret", {"attn_impl": "pallas"}, True),
@@ -918,8 +921,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.quick:
             rates = [6.0]
             num_requests = args.num_requests or 24
+            # async_step rides the quick gate so the double-buffered loop
+            # stays SLO-clean under live traffic, not just in unit tests.
             configs = (
-                args.configs.split(",") if args.configs else ["base"]
+                args.configs.split(",")
+                if args.configs
+                else ["base", "async_step"]
             )
         else:
             rates = [4.0, 12.0]

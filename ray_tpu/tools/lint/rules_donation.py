@@ -872,7 +872,12 @@ class HostSyncInStepLoopRule(Rule):
         "immediately syncs its result ( .item(), float(), np.asarray, "
         "device_get, block_until_ready ) serializes host and device — "
         "the device idles while the host reads, every single iteration. "
-        "Keep per-step values on device and sync once after the loop."
+        "Keep per-step values on device and sync once after the loop. "
+        "Exception: a value with an async host copy already in flight "
+        "(`x.copy_to_host_async()` earlier in the same loop body, alias "
+        "assignments included) may be read blocking — that is the "
+        "deferred-commit half of a double-buffered step loop, and by the "
+        "time the read runs the copy has long overlapped device compute."
     )
     bad_example = """
         import jax
@@ -916,10 +921,15 @@ class HostSyncInStepLoopRule(Rule):
             if not jit_calls:
                 continue
             tainted = self._jit_result_names(module, body_nodes, jit_calls)
+            prefetched = self._prefetched_names(
+                module, body_nodes, tainted
+            )
             for node in body_nodes:
                 if not isinstance(node, ast.Call) or id(node) in flagged:
                     continue
-                label = self._sync_label(module, node, tainted, jit_calls)
+                label = self._sync_label(
+                    module, node, tainted, jit_calls, prefetched
+                )
                 if label is None:
                     continue
                 flagged.add(id(node))
@@ -1009,6 +1019,45 @@ class HostSyncInStepLoopRule(Rule):
         return tainted
 
     @staticmethod
+    def _prefetched_names(module, body_nodes, tainted: Set[str]) -> Set[str]:
+        """Names whose device value has an async host copy in flight:
+        `x.copy_to_host_async()` appears in the same loop body on a
+        tainted name. A later blocking read of such a name is the
+        deferred-commit half of a double-buffered step loop, not a
+        stall. Plain aliases propagate (`prev = out` keeps the one-step-
+        behind idiom clean); fixed point so statement order inside the
+        loop body does not matter."""
+        prefetched: Set[str] = set()
+        for node in body_nodes:
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "copy_to_host_async"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in tainted
+            ):
+                prefetched.add(node.func.value.id)
+        changed = bool(prefetched)
+        while changed:
+            changed = False
+            for node in body_nodes:
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in prefetched
+                ):
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            if (
+                                isinstance(sub, ast.Name)
+                                and isinstance(sub.ctx, ast.Store)
+                                and sub.id not in prefetched
+                            ):
+                                prefetched.add(sub.id)
+                                changed = True
+        return prefetched
+
+    @staticmethod
     def _is_sync_shaped(module, expr: ast.AST) -> bool:
         """Structurally a host-sync call (float/int/np.asarray/.item/
         device_get/...), regardless of what it is applied to. A
@@ -1033,15 +1082,22 @@ class HostSyncInStepLoopRule(Rule):
         return _sync_dotted(dotted)
 
     def _sync_label(
-        self, module, call: ast.Call, tainted: Set[str], jit_calls
+        self, module, call: ast.Call, tainted: Set[str], jit_calls,
+        prefetched: Set[str] = frozenset(),
     ) -> Optional[str]:
         func = call.func
         jit_ids = {id(c) for c in jit_calls}
 
         def arg_is_device_value() -> bool:
+            # Prefetched names are exempt: their host copy is already in
+            # flight, so the blocking read is a commit, not a stall.
             for a in call.args:
                 for n in ast.walk(a):
-                    if isinstance(n, ast.Name) and n.id in tainted:
+                    if (
+                        isinstance(n, ast.Name)
+                        and n.id in tainted
+                        and n.id not in prefetched
+                    ):
                         return True
                     if id(n) in jit_ids:
                         return True
@@ -1053,7 +1109,11 @@ class HostSyncInStepLoopRule(Rule):
             and not call.args
         ):
             recv = func.value
-            if isinstance(recv, ast.Name) and recv.id in tainted:
+            if (
+                isinstance(recv, ast.Name)
+                and recv.id in tainted
+                and recv.id not in prefetched
+            ):
                 return f"{recv.id}.item()"
             if id(recv) in jit_ids:
                 return ".item() on the step result"
@@ -1072,7 +1132,9 @@ class HostSyncInStepLoopRule(Rule):
         ):
             recv = func.value
             if (
-                isinstance(recv, ast.Name) and recv.id in tainted
+                isinstance(recv, ast.Name)
+                and recv.id in tainted
+                and recv.id not in prefetched
             ) or id(recv) in jit_ids:
                 return ".block_until_ready()"
         return None

@@ -698,3 +698,106 @@ def test_poisoned_request_isolated_through_serve_path(serve_ray):
     stats = handle.metrics.remote().result(timeout_s=30)
     assert stats["num_dead_letters"] == 1
     assert stats["wedged"] is False
+
+
+# ---------------- async step loop (PR 17) ----------------
+
+
+def test_async_poisoned_decode_attributes_one_step_late():
+    """Under async_scheduling a poisoned decode sequence surfaces at
+    COMMIT, one step after its program was dispatched. The failure must
+    be attributed to the DISPATCH step (failure_step() == current step
+    - 1, vs == current step in the sync loop), dead-letter only the
+    culprit with that step index, leave the innocent batchmate
+    token-identical, and return the pools to boot size."""
+    prompts = random_prompts((7, 6), seed=4)
+    attributed = {}
+    for mode in (False, True):
+        fi.clear()
+        fi.inject(
+            "llm.decode.seq",
+            match="poison-me",
+            nth=3,  # 3rd decode commit for that sequence, mid-stream
+            exc_factory=lambda: RuntimeError("decode bitflip"),
+        )
+        ecfg = EngineConfig(
+            block_size=8, num_blocks=64, max_decode_slots=4,
+            max_blocks_per_seq=8, async_scheduling=mode,
+        )
+        eng = LLMEngine(TINY, ecfg, seed=0)
+        boot_free = eng.allocator.num_free
+        ok_tokens = []
+        eng.add_request(
+            prompts[0], max_new_tokens=10, request_id="ok-0",
+            on_token=ok_tokens.append,
+        )
+        eng.add_request(
+            prompts[1], max_new_tokens=10, request_id="poison-me"
+        )
+        with pytest.raises(RuntimeError, match="decode bitflip"):
+            while eng.has_work():
+                eng.step()
+        attributed[mode] = (eng.failure_step(), eng._steps)
+        assert eng.culprit_for(RuntimeError()) == "poison-me"
+        assert eng.fail_request(
+            "poison-me", RuntimeError("decode bitflip")
+        )
+        while eng.has_work():
+            eng.step()
+        want = reference_greedy(
+            GPT(TINY), eng.runner.params, prompts[0], 10
+        )
+        assert ok_tokens == want, f"async={mode}: survivor diverged"
+        assert eng.allocator.num_free == boot_free
+        letters = eng.dead_letters()
+        assert [d["request_id"] for d in letters] == ["poison-me"]
+        assert letters[0]["step"] == attributed[mode][0]
+    # Sync attributes to the step that raised; async to the step that
+    # DISPATCHED the poisoned program — exactly one earlier.
+    fail_sync, steps_sync = attributed[False]
+    fail_async, steps_async = attributed[True]
+    assert fail_sync == steps_sync
+    assert fail_async == steps_async - 1
+
+
+def test_async_midstream_replica_kill_stream_resumes_token_identical(
+    serve_ray,
+):
+    """A replica dying mid-stream while its engine runs the ASYNC step
+    loop (a chained decode in flight at the moment of death) resumes on
+    another replica token-identically: the in-flight overshoot dies with
+    the replica, the resume re-submits prompt + delivered tokens, and the
+    client-visible greedy stream stays contiguous."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app, llm_stream_resume
+
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4,
+        max_blocks_per_seq=8, prefill_buckets=(8, 32),
+        async_scheduling=True,
+    )
+    handle = serve.run(
+        build_app(TINY, ecfg, engine_name="chaos-async", num_replicas=2),
+        name="llmchaos7",
+    )
+    prompt = random_prompts((7,), seed=7)[0]
+    n_new = 8
+    want = reference_greedy(
+        GPT(TINY), LLMEngine(TINY, ecfg, seed=0).runner.params, prompt, n_new
+    )
+    spec = fi.inject(
+        "replica.stream_item",
+        nth=4,  # die after delivering 3 tokens: decode pipeline is hot
+        exc_factory=lambda: ActorDiedError(None, "injected async kill"),
+    )
+    stream = handle.options(
+        stream=True, stream_resume_fn=llm_stream_resume
+    ).remote({"prompt_ids": prompt, "max_new_tokens": n_new, "stream": True})
+    tokens = [d["token_id"] for d in stream]
+    assert spec.fires == 1
+    assert tokens == want
+    # The surviving engine really served async (and drained cleanly).
+    engine = ray_tpu.get_actor("llm_engine:chaos-async")
+    stats = ray_tpu.get(engine.metrics.remote())
+    assert stats["async_scheduling"] is True
+    assert stats["inflight_steps"] == 0

@@ -1754,6 +1754,50 @@ def test_host_sync_device_get_and_block_until_ready_flagged():
     assert "RTL503" in rules_of(findings)
 
 
+def test_host_sync_prefetched_copy_to_host_async_exempt():
+    """The async-engine deferred-commit idiom: dispatch step N+1, start
+    `copy_to_host_async()` on its output, then block-read step N's value
+    (whose copy has been in flight a whole step). That blocking read is
+    a commit, not a stall — RTL503 must stay quiet, including through
+    the `prev = out` alias that carries the one-step-behind buffer."""
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def serve_loop(step_fn, params, n):
+            step = jax.jit(step_fn)
+            prev = None
+            committed = []
+            for _ in range(n):
+                params, out = step(params)
+                out.copy_to_host_async()
+                if prev is not None:
+                    committed.append(np.asarray(prev))
+                prev = out
+            return params, committed
+        """
+    )
+    assert "RTL503" not in rules_of(findings)
+    # Positive control: same loop shape, but the dispatch path reads the
+    # fresh result synchronously — no prefetch in flight, device stalls.
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+
+        def serve_loop(step_fn, params, n):
+            step = jax.jit(step_fn)
+            committed = []
+            for _ in range(n):
+                params, next_tokens = step(params)
+                committed.append(np.asarray(next_tokens))
+            return params, committed
+        """
+    )
+    assert "RTL503" in rules_of(findings)
+
+
 # ---------------------------------------------------------------------------
 # Family 6: sharding consistency
 # ---------------------------------------------------------------------------

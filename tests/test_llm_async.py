@@ -1,0 +1,425 @@
+"""Async double-buffered step loop (EngineConfig.async_scheduling).
+
+The tentpole splits each decode step into a dispatch phase and a deferred
+commit phase, pipelined one step deep: while step N's program runs on
+device, the host plans and dispatches step N+1 by chaining decode's
+`next_tokens` device array straight into the next step's `tokens` input
+(positions/context_lens advance +1 deterministically) and fetching values
+one step behind via `copy_to_host_async`. These tests pin the contract:
+
+  * greedy outputs are TOKEN-IDENTICAL async on vs off — base case and
+    across the full feature matrix (prefix cache + CoW, chunked prefill,
+    preempt-resume under a tight pool, int8 KV, ngram + draft speculation,
+    the pallas kernel in interpret mode, tp=2, KV fabric);
+  * EOS / max-token finishes are detected one step late but the overshoot
+    token NEVER reaches the client — proven with a fixed-point prompt
+    whose greedy stream repeats its own EOS (a leak would duplicate it);
+  * the steady decode path allocates NO fresh host input buffers per step
+    (preallocated, reused, asserted by allocation count) in either mode;
+  * per-step dispatch/commit timestamps land in the flight record and the
+    llm_engine_step_host_gap_seconds histogram + stats() counters expose
+    the host gap, with chained dispatches recording exactly 0;
+  * async off is the default and leaves sync records free of async keys.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.llm import EngineConfig, KVFabricConfig, LLMEngine
+from ray_tpu.models.gpt import GPT, GPTConfig
+
+
+TINY = GPTConfig(
+    vocab_size=128,
+    num_layers=2,
+    num_heads=4,
+    embed_dim=64,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+# One layer for tp=2 / draft / fabric cells: semantics are per-block and
+# the smaller compile bill keeps the matrix inside the tier-1 budget.
+TINY1 = GPTConfig(
+    vocab_size=64,
+    num_layers=1,
+    num_heads=4,
+    embed_dim=32,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+DRAFT1 = GPTConfig(
+    vocab_size=64,
+    num_layers=1,
+    num_heads=2,
+    embed_dim=16,
+    max_seq_len=128,
+    dtype=jnp.float32,
+    attention_impl="reference",
+)
+
+BASE = dict(
+    block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=8
+)
+
+
+def reference_greedy(model, params, prompt, n_tokens, pad_to=64):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        padded = np.zeros((1, pad_to), np.int32)
+        padded[0, : len(toks)] = toks
+        logits = model.apply(params, jnp.asarray(padded))
+        t = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def random_prompts(lengths, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(0, vocab, size=n))) for n in lengths]
+
+
+def run_modes(model_cfg, prompts, n_new, repeat=False, **overrides):
+    """Generate with async_scheduling off and on; returns (sync, async,
+    async_engine). The async engine must fully drain its pipeline."""
+    outs = {}
+    engines = {}
+    for mode in (False, True):
+        eng = LLMEngine(
+            model_cfg,
+            EngineConfig(async_scheduling=mode, **overrides),
+            seed=0,
+        )
+        outs[mode] = eng.generate(prompts, max_new_tokens=n_new)
+        if repeat:  # cached-path pass: prefix hits + CoW shapes live
+            again = eng.generate(prompts, max_new_tokens=n_new)
+            assert again == outs[mode], "cached repeat diverged"
+        engines[mode] = eng
+    eng = engines[True]
+    assert eng.stats()["async_scheduling"] is True
+    assert eng.stats()["inflight_steps"] == 0, "pipeline not drained"
+    assert eng.allocator.num_allocated == 0
+    return outs[False], outs[True], eng
+
+
+# ---------------- token identity ----------------
+
+
+def test_async_greedy_matches_sync_and_reference():
+    """Base acceptance: mixed prompt/output lengths, async on vs off vs
+    the unbatched ground truth — and the async run really pipelined
+    (chained dispatches in the flight record, host gap of exactly 0 on
+    every chained step)."""
+    prompts = random_prompts((5, 11, 3, 17), seed=2)
+    sync, async_, eng = run_modes(TINY, prompts, 8, **BASE)
+    assert async_ == sync
+    model = GPT(TINY)
+    for prompt, out in zip(prompts, async_):
+        assert out == reference_greedy(model, eng.runner.params, prompt, 8)
+    steps = eng.flight_recorder.snapshot()["steps"]
+    chained = [s for s in steps if s.get("chained")]
+    assert len(chained) >= 4, "async loop never chained a dispatch"
+    assert all(s["host_gap_s"] == 0.0 for s in chained)
+    assert all(s["loop"] == "async" for s in chained)
+
+
+MATRIX = {
+    "prefix_cow": dict(TINY=True, repeat=True),
+    "chunked": dict(
+        TINY=True, repeat=True, max_prefill_tokens_per_step=8,
+        prefill_buckets=(8, 32),
+    ),
+    "int8": dict(TINY=True, kv_cache_dtype="int8"),
+    "spec_ngram": dict(
+        TINY=True, speculation="ngram", num_speculative_tokens=3
+    ),
+    "spec_draft": dict(speculation="draft", num_speculative_tokens=3),
+    "tp2": dict(tensor_parallel_size=2),
+}
+
+
+@pytest.mark.parametrize("feature", sorted(MATRIX))
+def test_async_identity_feature_matrix(feature):
+    """Async on/off token identity across the feature matrix. Spec modes
+    flush the pipeline every step (the proposer reads committed tokens),
+    so they exercise the async loop's non-chained dispatch + one-step-late
+    commit path rather than chaining."""
+    kw = dict(MATRIX[feature])
+    two_layer = kw.pop("TINY", False)
+    repeat = kw.pop("repeat", False)
+    if two_layer:
+        model_cfg, base = TINY, dict(BASE)
+        prompts = random_prompts((9, 8, 5), seed=6)
+    else:
+        model_cfg, base = TINY1, dict(
+            block_size=4, num_blocks=64, max_decode_slots=4,
+            max_blocks_per_seq=16,
+        )
+        prompts = random_prompts((9, 8, 5), vocab=64, seed=6)
+    if kw.get("speculation") == "draft":
+        kw["draft_model_config"] = DRAFT1
+    sync, async_, _ = run_modes(
+        model_cfg, prompts, 6, repeat=repeat, **base, **kw
+    )
+    assert async_ == sync, f"{feature}: async changed tokens"
+
+
+def test_async_identity_under_preemption_pressure():
+    """A pool far too small for the working set forces preempt-resume;
+    the async loop must flush before any step that preempts (a preempted
+    sequence's blocks cannot be freed with a dispatch in flight) and the
+    recompute path stays token-identical."""
+    kw = dict(
+        block_size=4, num_blocks=10, max_decode_slots=4,
+        max_blocks_per_seq=8,
+    )
+    prompts = random_prompts((6, 7, 5, 6), seed=1)
+    sync, async_, eng = run_modes(TINY, prompts, 12, **kw)
+    assert async_ == sync
+    assert eng.stats()["preemptions"] > 0, "pool never pressured"
+    model = GPT(TINY)
+    for prompt, out in zip(prompts, async_):
+        assert out == reference_greedy(model, eng.runner.params, prompt, 12)
+
+
+def test_async_identity_pallas_interpret():
+    """The chained device tokens feed the same jitted decode program, so
+    the fused pallas kernel (interpret mode on CPU) must be oblivious to
+    who produced its token input."""
+    kw = dict(
+        block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=4
+    )
+    prompts = random_prompts((5, 11), seed=31)
+    outs = {}
+    for mode in (False, True):
+        eng = LLMEngine(
+            TINY,
+            EngineConfig(attn_impl="pallas", async_scheduling=mode, **kw),
+            seed=0,
+        )
+        outs[mode] = eng.generate(prompts, max_new_tokens=4)
+        assert eng.stats()["attn_impl"] == "pallas"
+    assert outs[True] == outs[False]
+
+
+def test_async_identity_kv_fabric():
+    """The host-DRAM spill tier hooks (note_filled_blocks at commit,
+    restore as a flush boundary) see only committed state; fabric on must
+    not perturb the async stream."""
+    runtime = ray_tpu.init(num_cpus=4)
+    try:
+        prompts = random_prompts((9, 8, 5), vocab=64, seed=6)
+        base = dict(
+            block_size=4, num_blocks=16, max_decode_slots=4,
+            max_blocks_per_seq=8, prefill_buckets=(8, 32),
+        )
+        outs = {}
+        for mode in (False, True):
+            eng = LLMEngine(
+                TINY1,
+                EngineConfig(
+                    async_scheduling=mode,
+                    kv_fabric=KVFabricConfig(
+                        name=f"async-{mode}", byte_budget=8 << 20
+                    ),
+                    **base,
+                ),
+                seed=0,
+            )
+            first = eng.generate(prompts, max_new_tokens=6)
+            again = eng.generate(prompts, max_new_tokens=6)
+            assert first == again
+            outs[mode] = first
+        assert outs[True] == outs[False]
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------- EOS overshoot ----------------
+
+
+def test_async_eos_overshoot_never_emitted():
+    """EOS finishes are detected one step late under async_scheduling:
+    when the commit of step N sees the EOS, the chained step N+1 has
+    already run on device. That overshoot token must never reach the
+    client. The prompt is a fixed point — its greedy stream repeats the
+    EOS value forever ([83, 83, 83, 83, 15, 15, 15, ...], eos=15 first
+    emitted at index 4) — so a leaked overshoot would show up as a
+    duplicate EOS, the one corruption a lenient client would miss."""
+    prompt = [67, 123, 67, 103, 9, 83]
+    eng_ref = LLMEngine(TINY, EngineConfig(**BASE), seed=0)
+    want = eng_ref.generate([prompt], max_new_tokens=12)[0]
+    k = 4
+    eos = want[k]
+    assert want[k + 1] == eos and eos not in want[:k], (
+        "fixture drifted: stream no longer repeats its EOS", want
+    )
+    for mode in (False, True):
+        eng = LLMEngine(
+            TINY, EngineConfig(async_scheduling=mode, **BASE), seed=0
+        )
+        stream = []
+        free = eng.allocator.num_free
+        eng.add_request(
+            prompt, max_new_tokens=12, eos_id=eos, on_token=stream.append
+        )
+        while eng.has_work():
+            eng.step()
+        assert stream == want[: k + 1], (mode, stream)
+        assert eng.allocator.num_free == free
+        if mode:
+            steps = eng.flight_recorder.snapshot()["steps"]
+            # The finish really rode the pipeline: chained dispatches
+            # happened, and the drain after the EOS commit skipped the
+            # overshoot token (a commit entry with zero tokens).
+            assert any(s.get("chained") for s in steps)
+            drained = [
+                c
+                for s in steps
+                for c in s.get("commits", ())
+                if c["tokens"] == 0
+            ]
+            assert drained, "overshoot step was never drained"
+
+
+def test_async_max_tokens_overshoot_not_emitted():
+    """Same one-step-late finish for the max_new_tokens limit: the
+    chained dispatch past the last requested token is skipped at commit
+    and the stream length is exact."""
+    prompts = random_prompts((7, 5), seed=9)
+    sync, async_, _ = run_modes(TINY, prompts, 3, **BASE)
+    assert async_ == sync
+    assert all(len(o) == 3 for o in async_)
+
+
+# ---------------- buffer reuse (satellite: preallocated inputs) ----------------
+
+
+@pytest.mark.parametrize("mode", (False, True))
+def test_steady_decode_allocates_no_fresh_host_buffers(mode):
+    """The per-step decode inputs (tokens/positions/block_tables/
+    context_lens) are preallocated at engine init and reused: steady
+    decode steps make ZERO np.zeros allocations in either loop mode,
+    and the buffer objects themselves are stable across steps."""
+    eng = LLMEngine(
+        TINY, EngineConfig(async_scheduling=mode, **BASE), seed=0
+    )
+    for p in random_prompts((5, 9), seed=12):
+        eng.add_request(p, max_new_tokens=16)
+    eng.step()
+    eng.step()  # both admitted; loop is now pure decode
+    bufs = (
+        id(eng._dec_tokens), id(eng._dec_positions),
+        id(eng._dec_block_tables), id(eng._dec_context_lens),
+    )
+    calls = []
+    real_zeros = np.zeros
+    np.zeros = lambda *a, **kw: (calls.append(a), real_zeros(*a, **kw))[1]
+    try:
+        for _ in range(6):
+            eng.step()
+    finally:
+        np.zeros = real_zeros
+    assert calls == [], f"steady decode allocated host buffers: {calls}"
+    assert bufs == (
+        id(eng._dec_tokens), id(eng._dec_positions),
+        id(eng._dec_block_tables), id(eng._dec_context_lens),
+    )
+    while eng.has_work():
+        eng.step()
+
+
+# ---------------- host-gap metrics + flight record ----------------
+
+
+def test_host_gap_metrics_and_flight_record_surfaces():
+    """Satellite: per-step dispatch/commit timestamps in the flight
+    record, the llm_engine_step_host_gap_seconds histogram queryable via
+    the same helper the dashboard panel uses, and the stats() counters —
+    chained dispatches record a gap of exactly 0, sync dispatches a
+    positive gap."""
+    from ray_tpu.util.metrics import histogram_percentile
+
+    gaps = {}
+    for mode in (False, True):
+        eng = LLMEngine(
+            TINY, EngineConfig(async_scheduling=mode, **BASE), seed=0
+        )
+        eng.generate(random_prompts((5, 9), seed=3), max_new_tokens=8)
+        stats = eng.stats()
+        assert stats["host_gap_samples"] > 0
+        assert stats["host_gap_mean_s"] is not None
+        assert stats["host_gap_last_s"] is not None
+        gaps[mode] = stats
+        steps = [
+            s
+            for s in eng.flight_recorder.snapshot()["steps"]
+            if s.get("commits")
+        ]
+        assert steps
+        for s in steps:
+            # Every step that dispatched stamps the dispatch wall time;
+            # only an async drain-only step (commits the in-flight tail
+            # without queueing new work) legitimately has none.
+            if s["dispatch_time"] is None:
+                assert s.get("loop") == "async" and not s.get("chained")
+            for c in s["commits"]:
+                assert c["dispatch_step"] <= s["step"]
+                assert "time" in c and "tokens" in c
+        if mode:
+            assert any(s.get("chained") for s in steps)
+            assert all(
+                s["host_gap_s"] == 0.0 for s in steps if s.get("chained")
+            )
+            p50 = histogram_percentile(
+                "llm_engine_step_host_gap_seconds",
+                50.0,
+                {"engine": stats["engine_id"]},
+            )
+            assert p50 is not None and p50 >= 0.0
+        else:
+            assert all("loop" not in s for s in steps)
+            measured = [
+                s["host_gap_s"] for s in steps
+                if s["host_gap_s"] is not None
+            ]
+            assert measured and all(g > 0.0 for g in measured)
+    # Sync pays a real host gap every decode step; async's mean (chained
+    # steps pinned at 0) must come in below it on the same workload.
+    assert gaps[True]["host_gap_mean_s"] < gaps[False]["host_gap_mean_s"]
+
+
+def test_dashboard_percentiles_include_host_gap():
+    """The dashboard panel's percentile helper reads the host-gap series
+    alongside the SLO trio (null-safe before any observation)."""
+    from ray_tpu.dashboard.head import _llm_latency_percentiles
+
+    eng = LLMEngine(
+        TINY, EngineConfig(async_scheduling=True, **BASE), seed=0
+    )
+    eng.generate(random_prompts((6,), seed=4), max_new_tokens=6)
+    out = _llm_latency_percentiles(eng.stats()["engine_id"])
+    assert "host_gap_s" in out
+    assert out["host_gap_s"]["p50"] is not None
+    assert _llm_latency_percentiles("no-such-engine")["host_gap_s"] == {
+        "p50": None, "p99": None,
+    }
+
+
+def test_async_off_is_default_and_records_unchanged():
+    """async_scheduling defaults off; a default engine's flight records
+    carry no async keys and its stats report the loop disabled."""
+    assert EngineConfig(**BASE).async_scheduling is False
+    eng = LLMEngine(TINY, EngineConfig(**BASE), seed=0)
+    eng.generate(random_prompts((5,), seed=5), max_new_tokens=4)
+    stats = eng.stats()
+    assert stats["async_scheduling"] is False
+    assert stats["inflight_steps"] == 0
+    for s in eng.flight_recorder.snapshot()["steps"]:
+        assert "chained" not in s and "loop" not in s
