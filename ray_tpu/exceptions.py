@@ -110,6 +110,77 @@ class ReplicaDrainingError(ActorUnavailableError):
     the request's retry budget."""
 
 
+class EngineOverloadedError(ActorUnavailableError):
+    """Bounded admission rejected a submission: the engine's scheduler
+    backlog is at its configured cap (EngineConfig.max_queue_len /
+    max_queue_tokens), or the request's deadline had already passed at
+    submission. Subclasses ActorUnavailableError so the router's existing
+    failover machinery re-dispatches onto another replica — like
+    ReplicaDrainingError, a shed is a routing signal, not a failure.
+    `retry_after_s` is the engine's hint for when capacity is likely to
+    return (a rough queue-drain estimate, never a guarantee)."""
+
+    def __init__(
+        self,
+        engine: str = "",
+        reason: str = "queue full",
+        queue_len: int = 0,
+        retry_after_s: float = 0.0,
+    ):
+        self.engine = engine
+        self.reason = reason
+        self.queue_len = queue_len
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"engine {engine or '<unknown>'} shed the request: {reason} "
+            f"(queue_len={queue_len}, retry_after_s={retry_after_s:.3f})"
+        )
+
+    def __reduce__(self):
+        return (
+            EngineOverloadedError,
+            (self.engine, self.reason, self.queue_len, self.retry_after_s),
+        )
+
+
+class FleetOverloadedError(ActorError):
+    """Every replica the router could reach shed the request
+    (EngineOverloadedError from each): the fleet as a whole is past its
+    admission caps. Terminal and typed — the router surfaces this instead
+    of buffering the request or burning its retry budget redialing
+    replicas that have already said no. `retry_after_s` is the largest
+    hint any replica offered; callers should back off at least that long
+    before resubmitting."""
+
+    def __init__(
+        self,
+        deployment: str = "",
+        attempts: int = 0,
+        retry_after_s: float = 0.0,
+        last_error: "BaseException | None" = None,
+    ):
+        self.deployment = deployment
+        self.attempts = attempts
+        self.retry_after_s = retry_after_s
+        self.last_error = last_error
+        super().__init__(
+            f"deployment {deployment!r} is overloaded: every replica shed "
+            f"the request across {attempts} dispatch attempt(s); retry "
+            f"after {retry_after_s:.3f}s. Last error: {last_error!r}"
+        )
+
+    def __reduce__(self):
+        return (
+            FleetOverloadedError,
+            (
+                self.deployment,
+                self.attempts,
+                self.retry_after_s,
+                self.last_error,
+            ),
+        )
+
+
 class ReplicaUnavailableRetryExhausted(ActorError):
     """The Serve router's client-side failover gave up: every dispatch of a
     request within its retry budget landed on a dead/unavailable replica.

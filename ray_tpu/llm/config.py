@@ -37,8 +37,21 @@ class KVFabricConfig:
     # multi-turn sessions land where their cache already lives. Routing
     # only — the spill/restore tier works either way.
     affinity: bool = True
+    # Bound on every store RPC (single-block put/get/contains/stats; the
+    # batch put_many gets 6x — it moves a whole drain flush). A call that
+    # exceeds it degrades to a miss/no-op and bumps
+    # llm_engine_fabric_timeouts: the fabric is an accelerator, and a
+    # HUNG store actor must stall admission/eviction no longer than a
+    # dead one would.
+    rpc_timeout_s: float = 5.0
 
     def __post_init__(self):
+        if self.rpc_timeout_s <= 0:
+            raise ValueError(
+                f"kv_fabric.rpc_timeout_s must be > 0, got "
+                f"{self.rpc_timeout_s} — an unbounded store RPC lets a "
+                "hung store actor stall the engine step loop"
+            )
         if not self.name:
             raise ValueError(
                 "kv_fabric.name must be non-empty — it names the shared "
@@ -208,6 +221,19 @@ class EngineConfig:
     # dispatch index). Greedy outputs are token-identical either way;
     # False (the default) keeps the synchronous loop bit-for-bit.
     async_scheduling: bool = False
+    # Bounded admission: cap the scheduler backlog so overload fails fast
+    # at submission instead of queueing without bound. None (the default)
+    # keeps the waiting deque unbounded — bit-for-bit the pre-overload-
+    # control behavior. With a cap set, a submission that would push the
+    # backlog past max_queue_len requests (or max_queue_tokens queued
+    # prompt tokens, counting running prefills' remaining tokens) is
+    # rejected with a typed, retryable EngineOverloadedError carrying a
+    # retry-after hint; every rejection lands in the shed ring
+    # (LLMEngine.shed_requests()) and bumps llm_engine_shed_requests.
+    max_queue_len: Optional[int] = None
+    max_queue_tokens: Optional[int] = None
+    # How many shed records (id, reason, queue depth) to retain.
+    shed_capacity: int = 64
     # Per-request observability: lifecycle phase spans (queue/prefill/
     # decode/preempt via util.tracing), the TTFT / time-per-output-token /
     # queue / e2e / step-seconds histograms, and the per-step flight-
@@ -248,6 +274,18 @@ class EngineConfig:
             raise ValueError("dead_letter_capacity must be >= 1")
         if self.flight_recorder_capacity < 1:
             raise ValueError("flight_recorder_capacity must be >= 1")
+        if self.shed_capacity < 1:
+            raise ValueError("shed_capacity must be >= 1")
+        if self.max_queue_len is not None and self.max_queue_len < 1:
+            raise ValueError(
+                f"max_queue_len must be >= 1 or None (unbounded), got "
+                f"{self.max_queue_len} — a zero cap would shed every request"
+            )
+        if self.max_queue_tokens is not None and self.max_queue_tokens < 1:
+            raise ValueError(
+                f"max_queue_tokens must be >= 1 or None (unbounded), got "
+                f"{self.max_queue_tokens}"
+            )
         budget = self.max_prefill_tokens_per_step
         if budget is not None and budget > 0:
             if budget % self.block_size:

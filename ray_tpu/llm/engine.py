@@ -31,7 +31,7 @@ from typing import Callable, Deque, Dict, List, Optional
 import numpy as np
 
 from ray_tpu._private.fault_injection import maybe_fail
-from ray_tpu.exceptions import PoisonRequestError
+from ray_tpu.exceptions import EngineOverloadedError, PoisonRequestError
 from ray_tpu.llm.cache import BlockAllocator, blocks_for_tokens
 from ray_tpu.llm.config import EngineConfig
 from ray_tpu.llm.model_runner import GPTRunner
@@ -46,6 +46,7 @@ from ray_tpu.llm.observability import (
 from ray_tpu.llm.scheduler import (
     FINISH_EOS,
     FINISH_ERROR,
+    FINISH_EXPIRED,
     FINISH_LENGTH,
     Request,
     Scheduler,
@@ -148,7 +149,18 @@ class LLMEngine:
             # this module, so a top-level import would cycle.
             from ray_tpu.llm.kvfabric.store import KVFabricClient
 
-            self._fabric = KVFabricClient(fcfg.name, fcfg.byte_budget)
+            self._fabric = KVFabricClient(
+                fcfg.name,
+                fcfg.byte_budget,
+                rpc_timeout_s=fcfg.rpc_timeout_s,
+                # A store RPC that exceeds its bound degrades to a miss AND
+                # is counted distinctly (llm_engine_fabric_timeouts): a
+                # hung store actor must never stall admission or eviction,
+                # and an operator must be able to tell "store is slow"
+                # from "store is cold". Bound method on a not-yet-finished
+                # self is safe — the callback only fires on later RPCs.
+                on_timeout=self._note_fabric_timeout,
+            )
             # Spill on device eviction: demote a keyed block's content to
             # the host tier just before the allocator discards it.
             self.allocator.on_evict = self._spill_block
@@ -222,6 +234,22 @@ class LLMEngine:
             "Requests failed in isolation after poisoning an engine step",
             tag_keys=("engine",),
         )
+        self._shed_count = get_or_create(
+            Counter,
+            "llm_engine_shed_requests",
+            "Submissions rejected fast by bounded admission "
+            "(max_queue_len / max_queue_tokens) or dead-on-arrival "
+            "deadlines — typed overload sheds, not failures",
+            tag_keys=("engine",),
+        )
+        self._expired_count = get_or_create(
+            Counter,
+            "llm_engine_expired_requests",
+            "Admitted requests dropped at their end-to-end deadline "
+            "(queued: before any prefill ran; decoding: aborted "
+            "mid-stream with blocks reclaimed)",
+            tag_keys=("engine",),
+        )
         self._prefill_backlog = get_or_create(
             Gauge,
             "llm_engine_prefill_backlog_tokens",
@@ -281,6 +309,14 @@ class LLMEngine:
             "llm_engine_fabric_bytes_used",
             "Fabric store occupancy in bytes (the store is shared across "
             "engines on the fabric; refreshed on stats scrape)",
+            tag_keys=("engine",),
+        )
+        self._fabric_timeouts = get_or_create(
+            Counter,
+            "llm_engine_fabric_timeouts",
+            "Fabric store RPCs that exceeded kv_fabric.rpc_timeout_s and "
+            "degraded to a miss/no-op (a hung store never stalls "
+            "admission or eviction)",
             tag_keys=("engine",),
         )
         # Request-level latency histograms (the serving SLO trio + queue):
@@ -393,6 +429,16 @@ class LLMEngine:
         self._dead_letters: deque = deque(
             maxlen=self.engine_config.dead_letter_capacity
         )
+        # Overload control plane. The shed ring mirrors the dead-letter
+        # ring for bounded-admission rejections (shed_requests());
+        # _deadline_count gates the per-step expiry sweep so an engine
+        # that has never seen a deadline pays one int compare per step —
+        # the default path stays bit-for-bit.
+        self._sheds: deque = deque(maxlen=self.engine_config.shed_capacity)
+        self._shed_total = 0
+        self._expired_total = 0
+        self._fabric_timeout_total = 0
+        self._deadline_count = 0
         # Request whose per-sequence section of step() is currently running;
         # a step exception raised there is attributed to it.
         self._current_rid: Optional[str] = None
@@ -469,6 +515,7 @@ class LLMEngine:
         request_id: Optional[str] = None,
         on_token: Optional[Callable[[int], None]] = None,
         on_finish: Optional[Callable[[Sequence], None]] = None,
+        deadline_s: Optional[float] = None,
     ) -> str:
         ecfg = self.engine_config
         if max_new_tokens is None:
@@ -510,17 +557,60 @@ class LLMEngine:
         request_id = request_id or uuid.uuid4().hex
         if self.scheduler.is_active(request_id):
             raise ValueError(f"request_id {request_id!r} is already active")
+        if deadline_s is not None:
+            # Dead-on-arrival: the deadline (monotonic, set at the client
+            # boundary) passed in transit. Admitting it would spend a
+            # prefill program on tokens no caller can use.
+            now = time.monotonic()
+            if now >= deadline_s:
+                self._record_shed(request_id, "expired_at_submit", 0.0)
+                raise TimeoutError(
+                    f"request {request_id} arrived "
+                    f"{now - deadline_s:.3f}s past its deadline"
+                )
+        cap_len = ecfg.max_queue_len
+        cap_tok = ecfg.max_queue_tokens
+        if cap_len is not None or cap_tok is not None:
+            qlen = len(self.scheduler.waiting)
+            reason = None
+            if cap_len is not None and qlen >= cap_len:
+                reason = f"queue_len {qlen} >= max_queue_len {cap_len}"
+            elif cap_tok is not None:
+                qtok = self.scheduler.prefill_backlog_tokens()
+                if qtok + len(prompt_ids) > cap_tok:
+                    reason = (
+                        f"queued tokens {qtok} + prompt {len(prompt_ids)} "
+                        f"> max_queue_tokens {cap_tok}"
+                    )
+            if reason is not None:
+                # Rough drain hint, never a guarantee: one admission wave
+                # (~max_prefills_per_step worth of steps) per queued
+                # request ahead of the caller, capped so callers never
+                # sleep longer than the router's own backoff ceiling.
+                retry_after = min(
+                    2.0, 0.05 * (1.0 + qlen / ecfg.max_decode_slots)
+                )
+                self._record_shed(request_id, reason, retry_after)
+                raise EngineOverloadedError(
+                    engine=self._metric_tags["engine"],
+                    reason=reason,
+                    queue_len=qlen,
+                    retry_after_s=retry_after,
+                )
         req = Request(
             request_id=request_id,
             prompt_ids=prompt_ids,
             max_new_tokens=max_new_tokens,
             eos_id=eos_id,
+            deadline_s=deadline_s,
         )
         if on_token is not None:
             self._on_token[request_id] = on_token
         if on_finish is not None:
             self._on_finish[request_id] = on_finish
         self.scheduler.add(Sequence(req))
+        if deadline_s is not None:
+            self._deadline_count += 1
         if self._instrument:
             # Submission runs on the caller's thread (an actor-task context
             # when reached through LLMServer), so the ambient trace context
@@ -604,6 +694,79 @@ class LLMEngine:
         by EngineConfig.dead_letter_capacity)."""
         return list(self._dead_letters)
 
+    # ---------------- overload control ----------------
+
+    def _record_shed(
+        self, request_id: Optional[str], reason: str, retry_after_s: float
+    ) -> None:
+        """One rejected submission: ring entry (shed_requests()), counter,
+        and a flight-recorder shed record — every rejection leaves the
+        same three traces a dead letter does, so overload is auditable
+        after the fact, not just observable live."""
+        qlen = len(self.scheduler.waiting)
+        self._sheds.append(
+            {
+                "request_id": request_id,
+                "reason": reason,
+                "queue_len": qlen,
+                "retry_after_s": retry_after_s,
+                "step": self._steps,
+                "time": time.time(),
+            }
+        )
+        self._shed_total += 1
+        self._shed_count.inc(tags=self._metric_tags)
+        self.flight_recorder.record_shed(
+            request_id, reason, qlen, self._steps
+        )
+
+    def shed_requests(self) -> List[dict]:
+        """Records of submissions rejected by bounded admission (or dead
+        on arrival), oldest first (bounded by EngineConfig.shed_capacity)
+        — the dead_letters() analogue for the overload plane."""
+        return list(self._sheds)
+
+    def _note_fabric_timeout(self) -> None:
+        """KVFabricClient on_timeout hook: one store RPC exceeded its
+        bound and degraded to a miss/no-op."""
+        self._fabric_timeout_total += 1
+        self._fabric_timeouts.inc(tags=self._metric_tags)
+
+    def _expire_deadlines(self) -> None:
+        """Per-step deadline enforcement (monotonic clock, matching
+        Request.deadline_s — never wall time, which steps under NTP).
+        Runs at the top of both step loops, so a queued request whose
+        deadline passed is dropped BEFORE schedule_prefills can feed it
+        to a prefill program, and a decoding one goes through the normal
+        finish teardown — KV blocks, draft-mirror blocks, and any
+        lookahead reservation reclaimed within this step. Under
+        async_scheduling the sweep precedes the chain attempt: an expiry
+        is a batch-composition change, so the pipeline flushes and
+        _commit_head's inactive-skip drops the in-flight orphan token.
+        Engines that have never seen a deadline pay one int compare."""
+        if not self._deadline_count:
+            return
+        now = time.monotonic()
+        for seq in self.scheduler.expire_waiting(now):
+            self._record_expiry(seq, "queued")
+            self._finished(seq)
+        for seq in self.scheduler.expired_running(now):
+            self.scheduler.finish(seq, FINISH_EXPIRED)
+            self._record_expiry(seq, "running")
+            self._finished(seq)
+
+    def _record_expiry(self, seq: Sequence, phase: str) -> None:
+        self._expired_total += 1
+        self._expired_count.inc(tags=self._metric_tags)
+        rt = self._req_traces.get(seq.request.request_id)
+        if rt is not None:
+            # The request span closes with error status: an expiry is a
+            # terminal deadline miss, not a clean finish.
+            rt.error = "deadline expired"
+        self.flight_recorder.record_expiry(
+            seq.request.request_id, phase, self._steps, len(seq.generated)
+        )
+
     def close_traces(self, exc: BaseException) -> None:
         """Close every in-flight request's trace with error status. The
         wedge and shutdown broadcasts end requests WITHOUT _finished()
@@ -647,6 +810,9 @@ class LLMEngine:
         self._step_dispatch_wall = None
         self._step_commits = []
 
+        # Deadline sweep BEFORE admission: a queued request whose deadline
+        # passed must never reach schedule_prefills (resource-true expiry).
+        self._expire_deadlines()
         admitted = self.scheduler.schedule_prefills(
             ecfg.max_prefills_per_step
         )
@@ -687,7 +853,8 @@ class LLMEngine:
         # the exposition. One int compare each — nothing on the token path.
         family = (
             self._preemptions, self._prefix_hits, self._tokens_generated,
-            self._dead_letter_count, self._h_ttft, self._h_tpot,
+            self._dead_letter_count, self._shed_count, self._expired_count,
+            self._h_ttft, self._h_tpot,
             self._h_queue, self._h_e2e, self._h_step, self._h_host_gap,
         )
         if self._spec is not None:
@@ -699,7 +866,7 @@ class LLMEngine:
             family = family + (
                 self._fabric_spills, self._fabric_restores,
                 self._fabric_hits, self._fabric_hit_rate,
-                self._fabric_bytes_used,
+                self._fabric_bytes_used, self._fabric_timeouts,
             )
         for metric in family:
             metric._ensure_registered()
@@ -1137,6 +1304,11 @@ class LLMEngine:
         self._step_dispatch_wall = None
         self._step_commits = []
 
+        # Deadline sweep before the chain attempt: an expiry changes the
+        # batch composition, so _try_chain refuses and the pipeline
+        # flushes — the expired sequence's in-flight token is dropped by
+        # _commit_head's inactive-skip, never emitted.
+        self._expire_deadlines()
         # Chained dispatch FIRST — before any commit, admission, or
         # metric work: the whole point is that the device gets its next
         # program while the host still owes this step's bookkeeping. A
@@ -1193,7 +1365,8 @@ class LLMEngine:
         self._steps += 1
         family = (
             self._preemptions, self._prefix_hits, self._tokens_generated,
-            self._dead_letter_count, self._h_ttft, self._h_tpot,
+            self._dead_letter_count, self._shed_count, self._expired_count,
+            self._h_ttft, self._h_tpot,
             self._h_queue, self._h_e2e, self._h_step, self._h_host_gap,
         )
         if self._spec is not None:
@@ -1205,7 +1378,7 @@ class LLMEngine:
             family = family + (
                 self._fabric_spills, self._fabric_restores,
                 self._fabric_hits, self._fabric_hit_rate,
-                self._fabric_bytes_used,
+                self._fabric_bytes_used, self._fabric_timeouts,
             )
         for metric in family:
             metric._ensure_registered()
@@ -1644,6 +1817,11 @@ class LLMEngine:
 
     def _finished(self, seq: Sequence) -> None:
         req_id = seq.request.request_id
+        if seq.request.deadline_s is not None:
+            # Terminal for any reason: this deadline no longer needs the
+            # per-step sweep. Clamped so a double-finish can never drive
+            # the gate negative and disable expiry for live requests.
+            self._deadline_count = max(0, self._deadline_count - 1)
         if self._spec is not None:
             # Terminal for any reason (finish, abort, dead-letter): the
             # proposer's per-request resources (draft KV blocks) go with
@@ -1772,7 +1950,15 @@ class LLMEngine:
                 self._fabric_restored_tokens / max(self._prefill_tokens, 1)
             ),
             "fabric_store": fabric_store,
+            "fabric_timeouts": self._fabric_timeout_total,
             "num_dead_letters": len(self._dead_letters),
+            # Overload control plane: bounded-admission rejections and
+            # deadline expiries (llm_engine_shed_requests /
+            # llm_engine_expired_requests counters carry the same totals).
+            "shed_requests": self._shed_total,
+            "expired_requests": self._expired_total,
+            "max_queue_len": self.engine_config.max_queue_len,
+            "max_queue_tokens": self.engine_config.max_queue_tokens,
             "speculation": (
                 self._spec.name if self._spec is not None else "off"
             ),
@@ -2108,6 +2294,7 @@ class LLMServer:
         max_new_tokens: Optional[int],
         eos_id: Optional[int],
         request_id: Optional[str],
+        deadline_s: Optional[float] = None,
     ) -> tuple[str, _RequestState]:
         state = _RequestState()
 
@@ -2127,6 +2314,11 @@ class LLMServer:
                     f"request_id {request_id!r} already has an in-flight "
                     "generation on this server"
                 )
+            # Bounded admission fails fast HERE: add_request raises a
+            # typed, retryable EngineOverloadedError before any state
+            # lands in _requests — the caller (and through it the Serve
+            # router) sees the shed in one lock acquisition, never after
+            # queueing.
             rid = self._engine.add_request(
                 prompt_ids,
                 max_new_tokens=max_new_tokens,
@@ -2134,6 +2326,7 @@ class LLMServer:
                 request_id=request_id,
                 on_token=state.tokens.put,
                 on_finish=on_finish,
+                deadline_s=deadline_s,
             )
             self._requests[rid] = state
             self._work.notify_all()
@@ -2149,7 +2342,19 @@ class LLMServer:
         request_id: Optional[str] = None,
         timeout_s: float = 120.0,
     ) -> dict:
-        rid, state = self._submit(prompt_ids, max_new_tokens, eos_id, request_id)
+        """Blocking generation. `timeout_s` is the request's END-TO-END
+        deadline: it bounds this call's wait AND rides into the engine as
+        an absolute monotonic deadline, so a request that cannot finish in
+        time is dropped from the queue before its prefill ever runs (or
+        aborted mid-decode with its blocks reclaimed) instead of decoding
+        for a caller that already gave up. Either side tripping first
+        raises TimeoutError."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        rid, state = self._submit(
+            prompt_ids, max_new_tokens, eos_id, request_id, deadline
+        )
         try:
             if not state.done.wait(timeout=timeout_s):
                 # The request may have finished in the instant between the
@@ -2162,6 +2367,16 @@ class LLMServer:
                     )
             if state.error is not None:
                 raise state.error
+            if (
+                state.seq is not None
+                and state.seq.finish_reason == FINISH_EXPIRED
+            ):
+                # The ENGINE enforced the deadline (queued expiry or
+                # mid-decode abort) before this thread's own wait tripped:
+                # same contract, same error.
+                raise TimeoutError(
+                    f"generation {rid} exceeded its {timeout_s}s deadline"
+                )
             token_ids = []
             while True:
                 item = state.tokens.get_nowait()
@@ -2185,23 +2400,65 @@ class LLMServer:
         eos_id: Optional[int] = None,
         request_id: Optional[str] = None,
         timeout_s: float = 120.0,
+        stream_idle_timeout_s: Optional[float] = None,
     ):
-        """Yields token ids as the engine produces them."""
-        rid, state = self._submit(prompt_ids, max_new_tokens, eos_id, request_id)
+        """Yields token ids as the engine produces them.
+
+        `timeout_s` is the END-TO-END deadline — the same meaning as the
+        blocking path (it previously meant the per-token gap here; that
+        drift is exactly what `stream_idle_timeout_s` now carries). The
+        deadline rides into the engine, so an expiring stream is aborted
+        with its blocks reclaimed and this generator raises TimeoutError
+        after yielding whatever was already emitted.
+        `stream_idle_timeout_s` (optional) additionally bounds the gap
+        between consecutive tokens — the old `timeout_s` semantics for
+        callers that want a liveness check tighter than the deadline."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        rid, state = self._submit(
+            prompt_ids, max_new_tokens, eos_id, request_id, deadline
+        )
         try:
             while True:
+                wait = stream_idle_timeout_s
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    wait = (
+                        remaining
+                        if wait is None
+                        else min(wait, remaining)
+                    )
+                if wait is not None and wait < 0.0:
+                    wait = 0.0  # Queue.get rejects negative timeouts
                 try:
-                    item = state.tokens.get(timeout=timeout_s)
+                    item = state.tokens.get(timeout=wait)
                 except queue.Empty:
                     self.abort(rid)
+                    if (
+                        deadline is not None
+                        and time.monotonic() >= deadline
+                    ):
+                        raise TimeoutError(
+                            f"generation {rid} exceeded its {timeout_s}s "
+                            "deadline"
+                        ) from None
                     raise TimeoutError(
-                        f"generation {rid} produced no token for {timeout_s}s"
+                        f"generation {rid} produced no token for "
+                        f"{stream_idle_timeout_s}s"
                     ) from None
                 if item is _STREAM_END:
                     break
                 yield item
             if state.error is not None:
                 raise state.error
+            if (
+                state.seq is not None
+                and state.seq.finish_reason == FINISH_EXPIRED
+            ):
+                raise TimeoutError(
+                    f"generation {rid} exceeded its {timeout_s}s deadline"
+                )
         finally:
             # Closed before exhaustion (consumer disconnected / stream task
             # cancelled → GeneratorExit at the yield): the request is still
@@ -2257,6 +2514,13 @@ class LLMServer:
         with self._lock:
             return self._engine.dead_letters()
 
+    def shed_requests(self) -> List[dict]:
+        """Records of submissions rejected by bounded admission or dead
+        on arrival (id, reason, queue depth, retry-after hint), oldest
+        first — the overload plane's dead_letters()."""
+        with self._lock:
+            return self._engine.shed_requests()
+
     def flight_record(self, steps_limit: Optional[int] = None) -> dict:
         """The engine flight recorder: bounded rings of per-step records
         (phase, batch size, tokens, buckets, cache hits, preemptions,
@@ -2279,6 +2543,7 @@ class LLMServer:
             return {
                 "metrics": stats,
                 "dead_letters": self._engine.dead_letters(),
+                "shed_requests": self._engine.shed_requests(),
                 "flight_record": self._engine.flight_recorder.snapshot(
                     steps_limit
                 ),

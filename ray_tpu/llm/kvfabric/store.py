@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
 
 
 def payload_nbytes(payload: dict) -> int:
@@ -139,19 +140,47 @@ class KVFabricClient:
 
     Every method degrades to a miss/no-op when the store actor is gone
     (fleet teardown racing an engine's last steps) — the fabric is an
-    accelerator, never a correctness dependency."""
+    accelerator, never a correctness dependency. Every RPC is bounded by
+    `rpc_timeout_s` (put_many gets 6x — it moves a whole drain flush in
+    one call), so a HUNG store actor stalls the engine no longer than a
+    dead one; a timeout degrades to the same miss/no-op but additionally
+    fires `on_timeout`, which the engine wires to the
+    llm_engine_fabric_timeouts counter — "store is slow" and "store is
+    cold" must be distinguishable on a dashboard."""
 
-    def __init__(self, name: str, byte_budget: int):
+    def __init__(
+        self,
+        name: str,
+        byte_budget: int,
+        rpc_timeout_s: float = 5.0,
+        on_timeout: Optional[Callable[[], None]] = None,
+    ):
         self.name = name
+        self._timeout = float(rpc_timeout_s)
+        self._bulk_timeout = 6.0 * self._timeout
+        self._on_timeout = on_timeout
+        self.num_timeouts = 0
         self._actor = get_or_create_fabric_actor(name, byte_budget)
+
+    def _note_timeout(self) -> None:
+        self.num_timeouts += 1
+        if self._on_timeout is not None:
+            try:
+                self._on_timeout()
+            except Exception:
+                pass  # a counter hook must never break the degrade path
 
     def put(self, block_hash: int, payload: dict) -> bool:
         try:
             return bool(
                 ray_tpu.get(
-                    self._actor.put.remote(block_hash, payload), timeout=5.0
+                    self._actor.put.remote(block_hash, payload),
+                    timeout=self._timeout,
                 )
             )
+        except GetTimeoutError:
+            self._note_timeout()
+            return False
         except Exception:
             return False
 
@@ -161,17 +190,25 @@ class KVFabricClient:
         try:
             return int(
                 ray_tpu.get(
-                    self._actor.put_many.remote(items), timeout=30.0
+                    self._actor.put_many.remote(items),
+                    timeout=self._bulk_timeout,
                 )
             )
+        except GetTimeoutError:
+            self._note_timeout()
+            return 0
         except Exception:
             return 0
 
     def get_many(self, block_hashes: List[int]) -> List[Optional[dict]]:
         try:
             return ray_tpu.get(
-                self._actor.get_many.remote(list(block_hashes)), timeout=5.0
+                self._actor.get_many.remote(list(block_hashes)),
+                timeout=self._timeout,
             )
+        except GetTimeoutError:
+            self._note_timeout()
+            return [None] * len(block_hashes)
         except Exception:
             return [None] * len(block_hashes)
 
@@ -180,13 +217,22 @@ class KVFabricClient:
             return []
         try:
             return ray_tpu.get(
-                self._actor.contains.remote(list(block_hashes)), timeout=5.0
+                self._actor.contains.remote(list(block_hashes)),
+                timeout=self._timeout,
             )
+        except GetTimeoutError:
+            self._note_timeout()
+            return [False] * len(block_hashes)
         except Exception:
             return [False] * len(block_hashes)
 
     def stats(self) -> dict:
         try:
-            return ray_tpu.get(self._actor.stats.remote(), timeout=5.0)
+            return ray_tpu.get(
+                self._actor.stats.remote(), timeout=self._timeout
+            )
+        except GetTimeoutError:
+            self._note_timeout()
+            return {}
         except Exception:
             return {}

@@ -219,6 +219,12 @@ class FlightRecorder:
         self.steps: deque = deque(maxlen=capacity)
         self.compile_events: deque = deque(maxlen=128)
         self.failures: deque = deque(maxlen=128)
+        # Overload plane: bounded-admission rejections and deadline
+        # expiries. Recorded even with instrumentation off, like
+        # failures — shed/expired traffic is precisely the traffic an
+        # operator will be asked to explain after the fact.
+        self.sheds: deque = deque(maxlen=128)
+        self.expiries: deque = deque(maxlen=128)
 
     def record_step(self, record: dict) -> None:
         self.steps.append(record)
@@ -257,6 +263,45 @@ class FlightRecorder:
             }
         )
 
+    def record_shed(
+        self,
+        request_id: Optional[str],
+        reason: str,
+        queue_len: int,
+        step: int,
+    ) -> None:
+        """One submission rejected by bounded admission (or dead on
+        arrival): why, and how deep the backlog stood when it was shed."""
+        self.sheds.append(
+            {
+                "request_id": request_id,
+                "reason": reason,
+                "queue_len": queue_len,
+                "step": step,
+                "time": time.time(),
+            }
+        )
+
+    def record_expiry(
+        self,
+        request_id: str,
+        phase: str,
+        step: int,
+        tokens_generated: int,
+    ) -> None:
+        """One admitted request dropped at its deadline: "queued" means it
+        never cost a prefill program; "running" means it was aborted
+        mid-stream with its blocks reclaimed this step."""
+        self.expiries.append(
+            {
+                "request_id": request_id,
+                "phase": phase,
+                "step": step,
+                "tokens_generated": tokens_generated,
+                "time": time.time(),
+            }
+        )
+
     def snapshot(self, steps_limit: Optional[int] = None) -> dict:
         steps: List[dict] = list(self.steps)
         if steps_limit is not None and steps_limit >= 0:
@@ -271,4 +316,6 @@ class FlightRecorder:
             "steps": steps,
             "compile_events": list(self.compile_events),
             "failures": list(self.failures),
+            "sheds": list(self.sheds),
+            "expiries": list(self.expiries),
         }

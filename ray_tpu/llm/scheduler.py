@@ -45,6 +45,7 @@ FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 FINISH_ABORTED = "aborted"
 FINISH_ERROR = "error"  # dead-lettered after poisoning an engine step
+FINISH_EXPIRED = "expired"  # end-to-end deadline passed before completion
 
 _arrival = itertools.count()
 
@@ -55,6 +56,12 @@ class Request:
     prompt_ids: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    # Absolute MONOTONIC deadline (time.monotonic() seconds) after which
+    # the request must stop consuming engine resources: still queued →
+    # dropped before its prefill ever runs; decoding → aborted with its
+    # blocks reclaimed. None (the default) = no deadline, the pre-deadline
+    # behavior bit-for-bit. Derived from the client timeout at submission.
+    deadline_s: Optional[float] = None
 
 
 class Sequence:
@@ -173,6 +180,41 @@ class Scheduler:
             self.waiting.remove(seq)
         seq.finish_reason = FINISH_ABORTED
         return seq
+
+    # ---------------- deadline expiry ----------------
+
+    def expire_waiting(self, now: float) -> List[Sequence]:
+        """Drop every QUEUED sequence whose deadline has passed — before it
+        can cost a prefill program. A waiting sequence owns no blocks (a
+        preempt-resume victim released its table when preempted), so expiry
+        here is pure bookkeeping: pop from the queue, deactivate, mark
+        FINISH_EXPIRED. Returns the expired sequences so the engine can
+        notify waiters and write expiry records. `now` is monotonic-clock,
+        matching Request.deadline_s."""
+        expired = [
+            s
+            for s in self.waiting
+            if s.request.deadline_s is not None
+            and now >= s.request.deadline_s
+        ]
+        for seq in expired:
+            self.waiting.remove(seq)
+            self._active.pop(seq.request.request_id, None)
+            seq.finish_reason = FINISH_EXPIRED
+        return expired
+
+    def expired_running(self, now: float) -> List[Sequence]:
+        """RUNNING sequences whose deadline has passed. Selection only —
+        the engine finishes each through its normal teardown path so KV
+        blocks, draft-mirror blocks, and any lookahead reservation are all
+        reclaimed (and, under async_scheduling, so the deferred-commit
+        loop's inactive-sequence skip drops the in-flight orphan token)."""
+        return [
+            s
+            for s in self.running
+            if s.request.deadline_s is not None
+            and now >= s.request.deadline_s
+        ]
 
     # ---------------- admission (prefill) ----------------
 

@@ -84,9 +84,16 @@ class LLMIngress:
 
     Request schema: {"prompt_ids": [int, ...], "max_new_tokens": int?,
     "eos_id": int?, "stream": bool?, "request_id": str?, "timeout_s":
-    float?} — timeout_s bounds the engine-side wait (total for blocking
-    requests, per-token gap for streams; load harnesses set it so a
-    collapsed engine fails requests instead of parking client threads).
+    float?, "stream_idle_timeout_s": float?} — timeout_s is the request's
+    END-TO-END deadline on BOTH paths: the engine derives an absolute
+    monotonic deadline at submission and enforces it through admission,
+    queueing, and decode, so an expired request is dropped with its KV
+    (and draft-mirror) blocks reclaimed rather than decoding for a client
+    that stopped waiting. stream_idle_timeout_s additionally bounds the
+    PER-TOKEN gap on streams — the job timeout_s itself did before the
+    overload control plane landed; clients that relied on the old
+    per-token meaning should pass stream_idle_timeout_s instead (the old
+    field is still accepted, it just means the end-to-end budget now).
     """
 
     # Minimum gap between engine autoscaling_snapshot RPCs: the controller
@@ -138,8 +145,11 @@ class LLMIngress:
         eos_id = request.get("eos_id")
         request_id = request.get("request_id")
         timeout_s = request.get("timeout_s")
+        idle_timeout_s = request.get("stream_idle_timeout_s")
         kwargs = {} if timeout_s is None else {"timeout_s": float(timeout_s)}
         if request.get("stream"):
+            if idle_timeout_s is not None:
+                kwargs["stream_idle_timeout_s"] = float(idle_timeout_s)
             # A mid-stream client disconnect must be able to abort the
             # engine request (below), and abort is keyed by request_id —
             # pin one now when the client didn't.

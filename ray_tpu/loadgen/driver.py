@@ -55,6 +55,11 @@ class RequestSample:
     e2e_s: Optional[float] = None
     num_tokens: int = 0
     error: Optional[str] = None  # exception class name, None on success
+    # Dispatch → error surfaced. For overload sheds this is the REJECTION
+    # latency — the graceful-degradation gate requires rejections to be
+    # fast (cheaper than an accepted request's first token), and e2e_s is
+    # deliberately unset on errors so it can't carry the number.
+    error_latency_s: Optional[float] = None
     disconnected: bool = False
     # Populated only with record_tokens=True: the exact delivered token
     # ids, so chaos runs can assert migrated streams token-identical to an
@@ -147,6 +152,7 @@ def _drive_one(
     draining mid-stream migrates the stream to a surviving replica
     instead of erroring the sample."""
     sample.sent_s = time.perf_counter() - t0
+    sent = sample.sent_s  # latency base until dispatch completes below
     first = last = None
     n = 0
     if record_tokens:
@@ -192,6 +198,7 @@ def _drive_one(
                 break
     except BaseException as exc:  # noqa: BLE001 — error CLASS is the datum
         sample.error = type(exc).__name__
+        sample.error_latency_s = time.perf_counter() - t0 - sent
     end = time.perf_counter() - t0
     sample.num_tokens = n
     if first is not None:

@@ -47,6 +47,21 @@ ENGINE_HISTOGRAMS = {
     "queue_s": "llm_request_queue_time_seconds",
 }
 
+# Error classes carrying this marker are overload SHEDS — the control
+# plane working as designed, not the server failing. They are counted in
+# their own bucket (shed_rate) and excluded from failure_rate: a collapse
+# gate must be able to demand "zero failures" while sheds are expected.
+# Substring match, not equality: a shed raised inside an actor crosses
+# the object store as the dynamic TaskError-derived class
+# "TaskError(EngineOverloadedError)", and the driver records
+# type(exc).__name__ verbatim.
+SHED_ERROR_MARKER = "OverloadedError"
+
+
+def is_shed_error(error: Optional[str]) -> bool:
+    """Is this recorded error class an overload shed (vs a failure)?"""
+    return error is not None and SHED_ERROR_MARKER in error
+
 
 def percentile(samples: Sequence[float], q: float) -> Optional[float]:
     """q-th percentile (q in [0, 100]) with linear interpolation between
@@ -82,6 +97,11 @@ def build_report(
     errors: Dict[str, int] = {}
     for s in errored:
         errors[s.error] = errors.get(s.error, 0) + 1
+    shed = [s for s in errored if is_shed_error(s.error)]
+    failed = [s for s in errored if not is_shed_error(s.error)]
+    shed_latencies = [
+        s.error_latency_s for s in shed if s.error_latency_s is not None
+    ]
     populations = {
         "ttft_s": [
             s.ttft_s
@@ -104,6 +124,15 @@ def build_report(
         "errors": errors,
         "num_errors": len(errored),
         "error_rate": len(errored) / max(n, 1),
+        # Shed/failure split (see SHED_ERROR_MARKER): error_rate above
+        # stays the union for back-compat with recorded trajectories.
+        "num_shed": len(shed),
+        "shed_rate": len(shed) / max(n, 1),
+        "num_failures": len(failed),
+        "failure_rate": len(failed) / max(n, 1),
+        "shed_latency_s": {
+            pct_key(q): percentile(shed_latencies, q) for q in qs
+        },
         "offered_rate": result.offered_rate,
         "achieved_rate": result.achieved_rate,
         "offered_duration_s": result.offered_duration_s,
@@ -222,7 +251,12 @@ def format_report(report: dict, verdicts: Sequence[dict] = ()) -> str:
     lines = [
         f"requests={report['requests']} completed={report['completed']} "
         f"disconnected={report['disconnected']} "
-        f"errors={report['num_errors']} ({report['errors']})",
+        f"errors={report['num_errors']} ({report['errors']})"
+        + (
+            f" shed={report['num_shed']} failed={report['num_failures']}"
+            if report.get("num_shed")
+            else ""
+        ),
         f"offered={report['offered_rate']:.2f}/s "
         f"achieved={report['achieved_rate']:.2f}/s "
         f"wall={report['wall_duration_s']:.2f}s",

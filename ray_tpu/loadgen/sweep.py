@@ -348,6 +348,204 @@ def run_drain_cell(
         serve.shutdown()
 
 
+def run_collapse_cell(
+    rate: float,
+    num_requests: int,
+    seed: int,
+    timeout_s: float = 30.0,
+) -> dict:
+    """The overload-control cell: one replica, bounded admission
+    (max_queue_len), driven with a ramp arrival process from `rate` to
+    4x `rate` — past the tiny CPU engine's saturation point by design.
+    An unbounded engine would enter queueing collapse here: the backlog
+    grows without bound, every queued request's TTFT inherits the whole
+    backlog ahead of it, and nothing recovers until the offered load
+    stops. The control plane instead sheds what it cannot serve, so the
+    gate asserts graceful degradation: accepted requests stay within the
+    cell SLO, rejections are FAST (p99 rejection latency under the
+    accepted TTFT p50 — shedding that costs a queue traversal is not
+    shedding) and TYPED (every error is an OverloadedError shed, zero
+    untyped failures), the engine never wedges, and the KV + draft pools
+    drain back to boot size afterwards. Every request carries an
+    end-to-end deadline (the driver's timeout_s), so the deadline plane
+    is live under the same overload.
+
+    The engine-histogram cross-check is deliberately NOT run: shed
+    requests never reach the engine's histograms, so the two sides
+    legitimately measure different populations."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.config import EngineConfig
+    from ray_tpu.llm.serve import build_app
+    from ray_tpu.loadgen import report as report_mod
+    from ray_tpu.loadgen.arrivals import ArrivalSpec, arrival_times
+    from ray_tpu.loadgen.driver import run_open_loop
+    from ray_tpu.loadgen.scenarios import ScenarioSpec, generate_requests
+    from ray_tpu.loadgen.slo import (
+        IMPOSSIBLE_SLO,
+        LOOSE_SLO,
+        SLOSpec,
+        evaluate_slo,
+    )
+
+    # Backlog cap: one decode batch's worth of queued requests. Small
+    # enough that the ramp MUST shed, big enough that steady sub-
+    # saturation traffic never does.
+    overrides = {"max_queue_len": BASE_ENGINE["max_decode_slots"]}
+    ecfg = EngineConfig(**{**BASE_ENGINE, **overrides})
+    # The ramp must land PAST saturation regardless of how fast the host
+    # is: long decodes pin the service rate near
+    # max_decode_slots / decode_time, and the peak arrival rate is
+    # floored high enough that the backlog provably overruns the cap.
+    num_requests = max(num_requests, 64)
+    peak_rate = max(4.0 * rate, 400.0)
+    engine_name = f"loadgen-collapse-r{rate:g}-s{seed}"
+    app_name = f"lg-collapse-r{rate:g}"
+    handle = serve.run(
+        build_app(
+            serve_model_config(),
+            ecfg,
+            engine_name=engine_name,
+            max_concurrent_queries=64,
+        ),
+        name=app_name,
+        _blocking_timeout_s=300.0,
+    )
+    try:
+        handle.remote(
+            {"prompt_ids": [1, 2, 3], "max_new_tokens": 2}
+        ).result(timeout_s=300.0)
+
+        # Clean long-decode traffic: no poison, no disconnects — under
+        # overload the ONLY acceptable error class is a typed shed, so
+        # the scenario must not inject failures of its own. Long outputs
+        # hold decode slots, pinning the service rate well below the
+        # ramp's peak.
+        spec = ScenarioSpec.for_engine(
+            ecfg.max_model_len,
+            ecfg.buckets()[-1],
+            vocab_size=128,
+            name="longtail",
+            num_requests=num_requests,
+            seed=seed,
+            max_new_tokens=32,
+            output_len_median=24.0,
+            output_len_sigma=0.3,
+        )
+        requests = generate_requests(spec)
+        arrivals = ArrivalSpec(
+            process="ramp", rate=rate, ramp_to_rate=peak_rate, seed=seed
+        )
+        offsets = arrival_times(arrivals, len(requests))
+        result = run_open_loop(
+            handle,
+            requests,
+            offsets,
+            timeout_s=timeout_s,
+            settle_timeout_s=max(timeout_s * 2, 60.0),
+        )
+        stats = _drain_engine(handle)
+
+        rep = report_mod.build_report(result)
+        # Bounds on the ACCEPTED population only (sheds are expected and
+        # carry no latency samples): bounded-admission queue wait is at
+        # most max_queue_len prefills deep, which an unbounded queue at
+        # 4x saturation would blow through within seconds of the ramp.
+        collapse_slo = SLOSpec.from_bounds(
+            "collapse_accepted", ttft_p99=5.0, tpot_p99=1.0
+        )
+        verdicts = {
+            s.name: evaluate_slo(s, rep)
+            for s in (LOOSE_SLO, IMPOSSIBLE_SLO, collapse_slo)
+        }
+        return {
+            "config": "collapse_ramp",
+            "knobs": {
+                **overrides,
+                "arrival": f"ramp to {peak_rate:g}/s past saturation",
+            },
+            "cpu_parity_only": False,
+            "rate": rate,
+            "arrival": arrivals.to_dict(),
+            "report": rep,
+            "slo": verdicts,
+            "engine": {
+                "wedged": stats.get("wedged"),
+                "dead_letters": stats.get("num_dead_letters"),
+                "kv_pool_allocated": stats.get("kv_pool_allocated"),
+                "spec_draft_pool_allocated": stats.get(
+                    "spec_draft_pool_allocated"
+                ),
+                "shed_requests": stats.get("shed_requests"),
+                "expired_requests": stats.get("expired_requests"),
+                "max_queue_len": stats.get("max_queue_len"),
+                "preemptions": stats.get("num_preemptions"),
+            },
+        }
+    finally:
+        try:
+            eng = ray_tpu.get_actor(f"llm_engine:{engine_name}")
+            ray_tpu.kill(eng)
+        except Exception:
+            pass  # engine never came up / already gone
+        serve.shutdown()
+
+
+def _gate_collapse(cell: dict) -> List[str]:
+    """Hard assertions for the collapse cell — the graceful-degradation
+    claim: the overload MUST have shed (a ramp to 4x saturation that
+    sheds nothing means the cap never bound), every error is a TYPED
+    shed, accepted requests hold the cell SLO, rejections are cheaper
+    than an accepted first token, no wedge, pools back at boot size."""
+    from ray_tpu.loadgen.report import is_shed_error
+
+    tag = f"{cell['config']}@{cell['rate']}"
+    rep = cell["report"]
+    problems = []
+    if rep["num_shed"] == 0:
+        problems.append(
+            f"{tag}: ramp past saturation shed nothing "
+            "(bounded admission never bound)"
+        )
+    if rep["num_failures"] != 0:
+        untyped = {
+            k: v for k, v in rep["errors"].items() if not is_shed_error(k)
+        }
+        problems.append(
+            f"{tag}: {rep['num_failures']} untyped failures under "
+            f"overload ({untyped}) — sheds must be typed, nothing else "
+            "may break"
+        )
+    if not cell["slo"]["collapse_accepted"]["passed"]:
+        problems.append(
+            f"{tag}: accepted requests broke the SLO under overload "
+            f"({cell['slo']['collapse_accepted']['checks']})"
+        )
+    if cell["slo"]["impossible"]["passed"]:
+        problems.append(f"{tag}: impossible SLO passed")
+    shed_p99 = rep["shed_latency_s"].get("p99")
+    ttft_p50 = rep["percentiles"]["ttft_s"].get("p50")
+    if shed_p99 is None or ttft_p50 is None or shed_p99 >= ttft_p50:
+        problems.append(
+            f"{tag}: rejections not fast (shed p99 {shed_p99} vs "
+            f"accepted ttft p50 {ttft_p50})"
+        )
+    if cell["engine"].get("wedged"):
+        problems.append(f"{tag}: engine wedged under overload")
+    if cell["engine"].get("kv_pool_allocated") not in (0, None):
+        problems.append(
+            f"{tag}: KV pool did not drain "
+            f"(allocated={cell['engine']['kv_pool_allocated']})"
+        )
+    if cell["engine"].get("spec_draft_pool_allocated") not in (0, None):
+        problems.append(f"{tag}: draft mirror pool did not drain")
+    if not cell["engine"].get("shed_requests"):
+        problems.append(
+            f"{tag}: engine recorded no sheds despite client-side sheds"
+        )
+    return problems
+
+
 def run_kv_fabric_cell(
     affinity: bool,
     rate: float,
@@ -768,6 +966,26 @@ def run_sweep(
         f"{drain_cell['drain'].get('num_migrated_requests')} stream(s)"
         + (f"  !! {drain_problems}" if drain_problems else "")
     )
+    # The overload-control cell: a ramp driven past saturation against
+    # bounded admission rides every sweep (quick included), so a
+    # queueing-collapse regression — unbounded backlog, slow or untyped
+    # rejections, leaked pools — can never ship behind a green record.
+    collapse_cell = run_collapse_cell(
+        rates[0], max(num_requests, 24), seed
+    )
+    cells.append(collapse_cell)
+    collapse_problems = _gate_collapse(collapse_cell)
+    problems.extend(collapse_problems)
+    crep = collapse_cell["report"]
+    print(
+        f"[{record_name}] collapse_ramp @ {rates[0]:g}/s->"
+        f"{collapse_cell['arrival'].get('ramp_to_rate', 0):g}/s: "
+        f"completed {crep['completed']}, "
+        f"shed {crep['num_shed']}, failures {crep['num_failures']}, "
+        f"shed p99 "
+        f"{(crep['shed_latency_s'].get('p99') or 0):.4f}s"
+        + (f"  !! {collapse_problems}" if collapse_problems else "")
+    )
     # The KV-fabric locality pair: multiturn over 2 per-replica engines
     # sharing one fabric, prefix-affinity routing on vs off — gated on
     # zero drops + at least one cross-replica fabric hit, on every sweep
@@ -805,7 +1023,11 @@ def run_sweep(
             "kv_fabric_p2c pair runs multiturn over two per-replica "
             "engines sharing one KV fabric (prefix-affinity routing on "
             "vs off), gated on zero drops + at least one cross-replica "
-            "fabric hit."
+            "fabric hit. The collapse_ramp cell drives a ramp to 4x past "
+            "saturation against bounded admission and gates on graceful "
+            "degradation: accepted requests within SLO, rejections fast "
+            "and typed (OverloadedError sheds, zero untyped failures), "
+            "no wedge, pools back at boot size."
         ),
         "engine_base": dict(BASE_ENGINE),
         "scenario": scenario.to_dict(),

@@ -61,6 +61,7 @@ class Deployment:
         graceful_shutdown_timeout_s: Optional[float] = None,
         request_retry_budget: Optional[int] = None,
         request_backoff_initial_s: Optional[float] = None,
+        request_backoff_jitter_seed: Optional[int] = None,
         stream_resume_fn: Optional[Callable] = None,
         affinity_key_fn: Optional[Callable] = None,
     ) -> "Deployment":
@@ -85,6 +86,8 @@ class Deployment:
             cfg.request_retry_budget = request_retry_budget
         if request_backoff_initial_s is not None:
             cfg.request_backoff_initial_s = request_backoff_initial_s
+        if request_backoff_jitter_seed is not None:
+            cfg.request_backoff_jitter_seed = request_backoff_jitter_seed
         if stream_resume_fn is not None:
             cfg.stream_resume_fn = stream_resume_fn
         if affinity_key_fn is not None:
@@ -162,6 +165,7 @@ def run(
                 d._config.max_concurrent_queries,
                 retry_budget=d._config.request_retry_budget,
                 backoff_initial_s=d._config.request_backoff_initial_s,
+                backoff_jitter_seed=d._config.request_backoff_jitter_seed,
             )
         return a
 
@@ -193,6 +197,7 @@ def run(
         ingress._config.max_concurrent_queries,
         retry_budget=ingress._config.request_retry_budget,
         backoff_initial_s=ingress._config.request_backoff_initial_s,
+        backoff_jitter_seed=ingress._config.request_backoff_jitter_seed,
         stream_resume_fn=ingress._config.stream_resume_fn,
         affinity_key_fn=ingress._config.affinity_key_fn,
     )
@@ -285,6 +290,9 @@ def _handle_with_configured_knobs(
         cfg.max_concurrent_queries,
         retry_budget=cfg.request_retry_budget,
         backoff_initial_s=cfg.request_backoff_initial_s,
+        backoff_jitter_seed=getattr(
+            cfg, "request_backoff_jitter_seed", None
+        ),
         # The deployment-declared mid-stream failover policy rides every
         # configured handle — including the HTTP proxy's — so streams
         # migrate off dying/draining replicas for HTTP clients too; the

@@ -175,6 +175,10 @@ class DeploymentConfig:
     # whose handlers are not idempotent.
     request_retry_budget: int = DEFAULT_RETRY_BUDGET
     request_backoff_initial_s: float = DEFAULT_BACKOFF_INITIAL_S
+    # Seed for the router's full-jitter backoff RNG. None (production)
+    # seeds from entropy — decorrelated retry delays are the point of
+    # jitter; tests pin it for reproducible delay sequences.
+    request_backoff_jitter_seed: Optional[int] = None
     # Deployment-declared mid-stream failover policy: handles built from
     # this config (serve.run's return, get_app_handle — and therefore the
     # HTTP proxy's streaming path) resume interrupted streams through it,

@@ -31,6 +31,8 @@ from ray_tpu._private.runtime import get_runtime
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
+    EngineOverloadedError,
+    FleetOverloadedError,
     ReplicaDrainingError,
     ReplicaUnavailableRetryExhausted,
 )
@@ -68,6 +70,8 @@ class _RequestContext:
         "excluded",
         "failures",
         "drains",
+        "overloads",
+        "retry_after_s",
         "tag",
         "affinity_key",
     )
@@ -80,6 +84,8 @@ class _RequestContext:
         self.excluded: set[str] = set()
         self.failures = 0
         self.drains = 0  # planned drain migrations (budget-exempt)
+        self.overloads = 0  # bounded-admission sheds (budget-exempt)
+        self.retry_after_s = 0.0  # largest retry-after hint among sheds
         self.tag: Optional[str] = None  # replica serving the latest attempt
         # Replica-affinity key (deployment's affinity_key_fn over the
         # request payload, e.g. the prompt's leading block-chain hash);
@@ -322,6 +328,7 @@ class Router:
         max_concurrent_queries: int,
         retry_budget: Optional[int] = None,
         backoff_initial_s: Optional[float] = None,
+        backoff_jitter_seed: Optional[int] = None,
     ):
         self._app = app
         self._deployment = deployment
@@ -334,6 +341,11 @@ class Router:
             if backoff_initial_s is None
             else backoff_initial_s
         )
+        # Backoff jitter RNG: private instance, never the module-global
+        # random (whose state any library may touch). The seed knob exists
+        # for tests that need reproducible delays; production leaves it
+        # None — decorrelated retry times are the entire point.
+        self._rng = random.Random(backoff_jitter_seed)
         self._handle_id = uuid.uuid4().hex[:12]
         # Failover observability (PR 3 shipped the behavior with no
         # metrics): every router shares one registered counter per name,
@@ -369,6 +381,21 @@ class Router:
             "serve_router_drain_migrations",
             "Requests re-dispatched (or streams resumed) off a DRAINING "
             "replica — planned migrations, exempt from the retry budget",
+            tag_keys=("deployment",),
+        )
+        self._m_overloads = get_or_create(
+            Counter,
+            "serve_router_overload_redispatches",
+            "Requests re-dispatched after a replica shed them under "
+            "bounded admission (EngineOverloadedError) — routing signals, "
+            "exempt from the retry budget",
+            tag_keys=("deployment",),
+        )
+        self._m_fleet_overloaded = get_or_create(
+            Counter,
+            "serve_router_fleet_overloaded",
+            "Requests surfaced as FleetOverloadedError after every live "
+            "replica shed them",
             tag_keys=("deployment",),
         )
         self._lock = threading.Condition()
@@ -514,10 +541,36 @@ class Router:
         draining replica is excluded and the request re-dispatched after
         one short backoff (enough for the long-poll refresh of the shrunk
         replica set to land), without consuming the retry budget a real
-        replica death may still need."""
+        replica death may still need.
+
+        An EngineOverloadedError is a bounded-admission shed — likewise a
+        routing signal, not a failure: the shedding replica is excluded
+        and exactly the OTHER live replicas are worth one try each (a
+        different replica may front an engine with headroom). Once every
+        live replica has shed the request, retrying harder is the
+        queueing-collapse failure mode this control plane exists to
+        prevent — surface the typed FleetOverloadedError carrying the
+        engines' retry-after hint so the CALLER backs off, instead of
+        buffering or burning the retry budget a replica death may need."""
         if ctx.tag is not None and ctx.tag not in ctx.excluded:
             ctx.excluded.add(ctx.tag)
             self._m_excluded.inc(tags=self._dep_tags)
+        if isinstance(exc, EngineOverloadedError):
+            ctx.overloads += 1
+            hint = float(getattr(exc, "retry_after_s", 0.0) or 0.0)
+            ctx.retry_after_s = max(ctx.retry_after_s, hint)
+            with self._lock:
+                num_live = len(self._replicas)
+            if ctx.overloads >= max(num_live, 1):
+                self._m_fleet_overloaded.inc(tags=self._dep_tags)
+                raise FleetOverloadedError(
+                    deployment=self._deployment,
+                    attempts=ctx.failures + ctx.overloads,
+                    retry_after_s=ctx.retry_after_s or self._backoff_initial_s,
+                    last_error=exc,
+                ) from exc
+            self._m_overloads.inc(tags=self._dep_tags)
+            return self._backoff_initial_s
         if isinstance(exc, ReplicaDrainingError) and ctx.drains < DRAIN_RETRY_CAP:
             ctx.drains += 1
             self._m_drain_migrations.inc(tags=self._dep_tags)
@@ -531,10 +584,18 @@ class Router:
                 last_error=exc,
             ) from exc
         self._m_retries.inc(tags=self._dep_tags)
-        return min(
+        # FULL jitter (uniform over [0, exponential cap]), not a raw
+        # exponential ladder: correlated failures put N callers on the
+        # SAME deterministic retry schedule, so every wave re-arrives in
+        # lockstep and re-saturates the replica that just came back.
+        # Sampling the whole interval decorrelates the waves; the
+        # expected delay halves, but the budgeted worst case (cap) and
+        # the ladder's growth rate are unchanged.
+        cap = min(
             self._backoff_initial_s * BACKOFF_MULTIPLIER ** (ctx.failures - 1),
             BACKOFF_MAX_S,
         )
+        return self._rng.uniform(0.0, cap)
 
     def note_stream_resume(self) -> None:
         """One mid-stream failover actually resumed (items already
@@ -719,6 +780,7 @@ class DeploymentHandle:
         stream_resume_fn: Optional[Callable] = None,
         _router_cell: Optional[_RouterCell] = None,
         affinity_key_fn: Optional[Callable] = None,
+        backoff_jitter_seed: Optional[int] = None,
     ):
         self._app = app
         self._deployment = deployment
@@ -729,6 +791,7 @@ class DeploymentHandle:
         self._router_cell = _router_cell or _RouterCell(_router)
         self._retry_budget = retry_budget
         self._backoff_initial_s = backoff_initial_s
+        self._backoff_jitter_seed = backoff_jitter_seed
         self._stream_resume_fn = stream_resume_fn
         self._affinity_key_fn = affinity_key_fn
 
@@ -750,6 +813,7 @@ class DeploymentHandle:
                         self._max_q,
                         retry_budget=self._retry_budget,
                         backoff_initial_s=self._backoff_initial_s,
+                        backoff_jitter_seed=self._backoff_jitter_seed,
                     )
         return cell.router
 
@@ -769,9 +833,12 @@ class DeploymentHandle:
         backoff_initial_s: Optional[float] = None,
         stream_resume_fn: Optional[Callable] = None,
         affinity_key_fn: Optional[Callable] = None,
+        backoff_jitter_seed: Optional[int] = None,
     ) -> "DeploymentHandle":
         changed_router_cfg = (
-            retry_budget is not None or backoff_initial_s is not None
+            retry_budget is not None
+            or backoff_initial_s is not None
+            or backoff_jitter_seed is not None
         )
         h = DeploymentHandle(
             self._app,
@@ -800,6 +867,9 @@ class DeploymentHandle:
             affinity_key_fn=affinity_key_fn
             if affinity_key_fn is not None
             else self._affinity_key_fn,
+            backoff_jitter_seed=backoff_jitter_seed
+            if backoff_jitter_seed is not None
+            else self._backoff_jitter_seed,
         )
         return h
 
@@ -823,6 +893,7 @@ class DeploymentHandle:
                 self._backoff_initial_s,
                 self._stream_resume_fn,
                 self._affinity_key_fn,
+                self._backoff_jitter_seed,
             ),
         )
 
@@ -841,6 +912,7 @@ def _rebuild_handle(
     backoff_initial_s=None,
     stream_resume_fn=None,
     affinity_key_fn=None,
+    backoff_jitter_seed=None,
 ) -> DeploymentHandle:
     return DeploymentHandle(
         app,
@@ -853,4 +925,5 @@ def _rebuild_handle(
         backoff_initial_s=backoff_initial_s,
         stream_resume_fn=stream_resume_fn,
         affinity_key_fn=affinity_key_fn,
+        backoff_jitter_seed=backoff_jitter_seed,
     )

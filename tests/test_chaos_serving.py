@@ -28,6 +28,8 @@ import ray_tpu
 from ray_tpu._private import fault_injection as fi
 from ray_tpu.exceptions import (
     ActorDiedError,
+    EngineOverloadedError,
+    FleetOverloadedError,
     PoisonRequestError,
     ReplicaUnavailableRetryExhausted,
 )
@@ -438,9 +440,13 @@ def test_unary_failover_retries_on_another_replica(serve_ray):
 
 
 def test_retry_budget_exhaustion_raises_typed_error_with_backoff(serve_ray):
-    """Acceptance: when every dispatch fails, the router backs off
-    exponentially between attempts and, after the configured budget,
-    surfaces ReplicaUnavailableRetryExhausted — not a raw ActorDiedError."""
+    """Acceptance: when every dispatch fails, the router backs off with
+    full jitter between attempts and, after the configured budget,
+    surfaces ReplicaUnavailableRetryExhausted — not a raw ActorDiedError.
+    The jitter seed makes the delay sequence deterministic: the expected
+    sleeps are recomputed here from the same seeded RNG."""
+    import random
+
     from ray_tpu import serve
 
     @serve.deployment
@@ -451,13 +457,16 @@ def test_retry_budget_exhaustion_raises_typed_error_with_backoff(serve_ray):
     assert handle.remote(1).result(timeout_s=30) == 1  # sanity: app works
 
     backoff = 0.05
+    seed = 1234
     spec = fi.inject(
         "actor.submit",
         match="ReplicaActor.handle_request",
         times=None,
         exc_factory=lambda: ActorDiedError(None, "injected submit failure"),
     )
-    tuned = handle.options(retry_budget=2, backoff_initial_s=backoff)
+    tuned = handle.options(
+        retry_budget=2, backoff_initial_s=backoff, backoff_jitter_seed=seed
+    )
     t0 = time.monotonic()
     with pytest.raises(ReplicaUnavailableRetryExhausted) as ei:
         tuned.remote(2)
@@ -465,11 +474,107 @@ def test_retry_budget_exhaustion_raises_typed_error_with_backoff(serve_ray):
     assert ei.value.attempts == 3  # initial + 2 retries
     assert isinstance(ei.value.last_error, ActorDiedError)
     assert spec.fires == 3
-    # Exponential backoff between attempts: 0.05s then 0.10s.
-    assert elapsed >= backoff + 2 * backoff
+    # Full-jitter backoff: each delay is uniform over [0, initial * 2^k].
+    # The router's RNG is private and seeded, so the exact draws are
+    # reproducible — the attempts slept at least their sum.
+    rng = random.Random(seed)
+    expected = rng.uniform(0.0, backoff) + rng.uniform(0.0, 2 * backoff)
+    assert elapsed >= expected
     fi.clear()
     # The deployment still serves once the faults stop.
     assert tuned.remote(3).result(timeout_s=30) == 3
+
+
+def test_overload_shed_redispatches_once_to_other_replica(serve_ray):
+    """An EngineOverloadedError from one replica is treated like a drain:
+    redispatch to the other replica (budget-exempt, no backoff ladder)
+    and the caller sees the result, never the shed."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def work(x):
+        return x + 1
+
+    handle = serve.run(work.bind(), name="overload-failover")
+    spec = fi.inject(
+        "replica.handle_request",
+        match="work",
+        times=1,
+        exc_factory=lambda: EngineOverloadedError(
+            engine="e0",
+            reason="queue_len 8 >= max_queue_len 8",
+            queue_len=8,
+            retry_after_s=0.01,
+        ),
+    )
+    assert handle.remote(1).result(timeout_s=30) == 2
+    assert spec.fires == 1  # the shed really happened, and was survived
+
+
+def test_fleet_overloaded_typed_rejection_with_retry_hint(serve_ray):
+    """When EVERY replica sheds, the router gives up after one attempt
+    per live replica and surfaces FleetOverloadedError carrying the
+    retry-after hint — fast typed rejection, not retry-budget burn."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def busy(x):
+        return x
+
+    handle = serve.run(busy.bind(), name="overload-fleet")
+    assert handle.remote(0).result(timeout_s=30) == 0  # sanity: app works
+    spec = fi.inject(
+        "replica.handle_request",
+        match="busy",
+        times=None,
+        exc_factory=lambda: EngineOverloadedError(
+            engine="e0",
+            reason="queue full",
+            queue_len=8,
+            retry_after_s=0.2,
+        ),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(FleetOverloadedError) as ei:
+        handle.remote(1).result(timeout_s=30)
+    elapsed = time.monotonic() - t0
+    assert ei.value.attempts == 2  # one try per live replica
+    assert ei.value.retry_after_s >= 0.2  # the engine's hint rides out
+    assert isinstance(ei.value.last_error, EngineOverloadedError)
+    assert spec.fires == 2
+    # Fast rejection: two dispatches and one short inter-replica pause,
+    # never the exponential retry ladder.
+    assert elapsed < 5.0
+    fi.clear()
+    assert handle.remote(3).result(timeout_s=30) == 3  # fleet recovered
+
+
+def test_single_replica_overload_rejects_immediately(serve_ray):
+    """With one live replica there is no 'other replica' to try: the
+    first shed becomes FleetOverloadedError with zero backoff sleeps."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    def solo(x):
+        return x
+
+    handle = serve.run(solo.bind(), name="overload-solo")
+    assert handle.remote(0).result(timeout_s=30) == 0
+    spec = fi.inject(
+        "replica.handle_request",
+        match="solo",
+        times=None,
+        exc_factory=lambda: EngineOverloadedError(
+            engine="e0", reason="queue full", queue_len=4,
+            retry_after_s=0.05,
+        ),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(FleetOverloadedError) as ei:
+        handle.remote(1).result(timeout_s=30)
+    assert ei.value.attempts == 1
+    assert spec.fires == 1
+    assert time.monotonic() - t0 < 2.0
 
 
 def _build_llm_app(serve_run, engine_name, app_name, num_replicas=2):

@@ -430,6 +430,63 @@ def test_fabric_observability_counters_and_flight_record(ray_fixture):
     assert "llm_engine_fabric_hit_rate" in text
 
 
+def test_fabric_client_rpc_timeout_degrades_to_miss(ray_fixture, monkeypatch):
+    """A store RPC exceeding its bound degrades to the same miss/no-op a
+    dead store gives — bounded by rpc_timeout_s (put_many gets 6x for
+    bulk flushes), counted on the client, and surfaced through the
+    on_timeout hook so the engine's llm_engine_fabric_timeouts counter
+    can distinguish 'store is slow' from 'store is cold'."""
+    from ray_tpu.exceptions import GetTimeoutError
+    from ray_tpu.llm.kvfabric.store import KVFabricClient
+
+    fired = []
+    client = KVFabricClient(
+        "timeouty", byte_budget=1 << 20, rpc_timeout_s=1.5,
+        on_timeout=lambda: fired.append(1),
+    )
+    seen_timeouts = []
+
+    def slow_get(ref, timeout=None):
+        seen_timeouts.append(timeout)
+        raise GetTimeoutError("injected store stall")
+
+    monkeypatch.setattr(ray_tpu, "get", slow_get)
+    assert client.put(1, _payload(16)) is False
+    assert client.put_many([(2, _payload(16))]) == 0
+    assert client.get_many([1, 2]) == [None, None]
+    assert client.contains([1]) == [False]
+    assert client.stats() == {}
+    assert client.num_timeouts == 5
+    assert len(fired) == 5
+    # Unary RPCs use rpc_timeout_s; the bulk flush gets 6x.
+    assert seen_timeouts == [1.5, 9.0, 1.5, 1.5, 1.5]
+    # Empty batches never pay an RPC at all.
+    assert client.put_many([]) == 0 and client.contains([]) == []
+    assert client.num_timeouts == 5
+    monkeypatch.undo()
+    # The client keeps serving normally once the stall clears.
+    assert client.put(99, _payload(16)) is True
+    assert client.contains([99]) == [True]
+    assert client.num_timeouts == 5
+
+
+def test_engine_wires_fabric_timeouts_to_counter(ray_fixture):
+    """KVFabricConfig.rpc_timeout_s reaches the engine's client, and the
+    on_timeout hook lands in stats()['fabric_timeouts'] plus the exported
+    llm_engine_fabric_timeouts family."""
+    fabric = KVFabricConfig(
+        name="tmo", byte_budget=8 << 20, rpc_timeout_s=0.75
+    )
+    eng = LLMEngine(TINY, EngineConfig(**BASE, kv_fabric=fabric), seed=0)
+    assert eng._fabric._timeout == 0.75
+    assert eng.stats()["fabric_timeouts"] == 0
+    eng._fabric._note_timeout()  # what a stalled RPC's except-path calls
+    assert eng.stats()["fabric_timeouts"] == 1
+    from ray_tpu.util.metrics import prometheus_text
+
+    assert "llm_engine_fabric_timeouts" in prometheus_text()
+
+
 # ---------------- disaggregated prefill/decode ----------------
 
 

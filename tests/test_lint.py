@@ -822,6 +822,28 @@ def test_wallclock_deadline_and_duration_flagged():
     assert rules_of(findings).count("RTL302") == 2
 
 
+def test_monotonic_deadline_arithmetic_pinned():
+    """The overload control plane derives per-request end-to-end
+    deadlines as `time.monotonic() + timeout` and enforces them against
+    time.monotonic() — this fixture pins the idiom clean while its
+    wall-clock twin stays flagged, so deadline arithmetic can never
+    drift onto a clock that steps under NTP."""
+    findings = lint(
+        """
+        import time
+
+        def submit_ok(timeout_s):
+            deadline_s = time.monotonic() + timeout_s
+            return time.monotonic() >= deadline_s
+
+        def submit_bad(timeout_s):
+            deadline_s = time.time() + timeout_s
+            return time.time() >= deadline_s
+        """
+    )
+    assert rules_of(findings).count("RTL302") == 1
+
+
 def test_wallclock_identity_not_flagged():
     findings = lint(
         """

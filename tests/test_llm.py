@@ -704,6 +704,32 @@ def test_llm_serve_deployment_end_to_end(llm_ray):
     assert [d["token_id"] for d in streamed] == res["token_ids"]
 
 
+def test_llm_serve_deadline_propagates_to_engine(llm_ray):
+    """timeout_s rides handle → ingress → engine as an end-to-end
+    deadline: a zero budget is rejected at engine admission (typed
+    TimeoutError to the caller), never prefilled — and the same app still
+    serves requests with a sane budget afterwards."""
+    from ray_tpu import serve
+    from ray_tpu.llm.serve import build_app
+
+    ecfg = EngineConfig(
+        block_size=8, num_blocks=64, max_decode_slots=4, max_blocks_per_seq=8
+    )
+    handle = serve.run(
+        build_app(TINY, ecfg, engine_name="deadline"), name="llmapp-deadline"
+    )
+    prompt = random_prompts((5,), seed=11)[0]
+    with pytest.raises(TimeoutError, match="deadline"):
+        handle.remote(
+            {"prompt_ids": prompt, "max_new_tokens": 4, "timeout_s": 0.0}
+        ).result(timeout_s=60)
+    res = handle.remote(
+        {"prompt_ids": prompt, "max_new_tokens": 4, "timeout_s": 60.0}
+    ).result(timeout_s=60)
+    assert len(res["token_ids"]) == 4
+    assert res["finish_reason"] == "length"
+
+
 def test_cow_copy_failure_releases_copy_source_ref():
     """Regression (found by `ray-tpu lint` RTL403 cleared-before-commit):
     a copy-on-write prefill whose device block copy raises must not leak

@@ -25,6 +25,7 @@ from ray_tpu.loadgen import (
     arrival_times,
     build_report,
     evaluate_slo,
+    format_report,
     generate_requests,
     schedule_fingerprint,
 )
@@ -221,6 +222,44 @@ def test_slo_report_counts_errors_not_latency_samples():
     errors_too = SLOSpec.from_bounds("err", ttft_p99=1.0, error_rate=0.1)
     assert evaluate_slo(latency_only, report)["passed"] is True
     assert evaluate_slo(errors_too, report)["passed"] is False
+
+
+def test_report_splits_sheds_from_failures():
+    """Overload sheds (any *OverloadedError class, including the
+    TaskError(EngineOverloadedError) dynamic name an actor-crossing shed
+    arrives as) are counted apart from real failures, with their own
+    rejection-latency percentiles; error_rate stays the union for
+    back-compat with recorded trajectories."""
+    result = _fake_result(n_ok=10, n_err=1)  # one real failure (poison)
+    for i, (cls, lat) in enumerate(
+        [
+            ("TaskError(EngineOverloadedError)", 0.002),
+            ("EngineOverloadedError", 0.004),
+            ("FleetOverloadedError", 0.006),
+        ]
+    ):
+        result.samples.append(
+            RequestSample(
+                request_id=f"shed-{i}", kind="normal", scenario="longtail",
+                session_id=None, scheduled_s=1.0, sent_s=1.0,
+                error=cls, error_latency_s=lat,
+            )
+        )
+    report = build_report(result)
+    assert report["num_shed"] == 3
+    assert report["num_failures"] == 1
+    assert report["num_errors"] == 4  # the union, unchanged
+    assert report["shed_rate"] == pytest.approx(3 / 14)
+    assert report["failure_rate"] == pytest.approx(1 / 14)
+    assert report["error_rate"] == pytest.approx(4 / 14)
+    # Rejection latency percentiles come from error_latency_s (e2e_s is
+    # deliberately unset on errors so it can't carry the number).
+    assert report["shed_latency_s"]["p50"] == pytest.approx(0.004)
+    assert report["shed_latency_s"]["p99"] <= 0.006
+    # Sheds never become latency samples for the accepted populations.
+    assert report["sample_counts"]["ttft_s"] == 10
+    line = format_report(report)
+    assert "shed=3" in line and "failed=1" in line
 
 
 def test_slo_no_samples_fails_not_passes():
