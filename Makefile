@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-changed lint-sarif lint-json test test-lint bench-serve-quick
+.PHONY: lint lint-changed lint-sarif lint-json test test-lint bench-serve-quick obs-smoke
 
 # Tree-clean gate: exit 1 on any active finding, untriaged baseline
 # entry, stale baseline entry, or parse error. Same entry point as the
@@ -43,3 +43,12 @@ test:
 bench-serve-quick:
 	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.loadgen.sweep sweep --quick \
 		--record-name BENCH_SERVE_quick --out /tmp/BENCH_SERVE_quick.json
+
+# Fleet observability smoke (rides tier-1 via the obs_smoke marker): a
+# seeded ~10s 2-replica loadgen run asserting the /api/fleet time ledger
+# sums to within 5% of each replica's measured wall and one sampled
+# request's Perfetto timeline export loads as valid Chrome-trace JSON
+# with handle -> router -> ingress -> engine rows and flow events.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_observability.py \
+		-q -m obs_smoke

@@ -267,17 +267,35 @@ def available_resources() -> dict[str, float]:
     return totals
 
 
-def timeline(filename: Optional[str] = None):
+def timeline(
+    filename: Optional[str] = None, trace_id: Optional[str] = None
+):
     """Chrome-trace timeline of task executions AND buffered tracing spans
     (reference: ray.timeline, _private/state.py:831 backed by GCS profile
     events; here backed by the runtime's task-event buffer plus the span
     buffer, so `llm.*` serving and `train.*` training spans appear on the
     same timeline as their tasks). Returns the trace records, and writes
     them as JSON when `filename` is given — load in chrome://tracing or
-    Perfetto."""
+    Perfetto.
+
+    With `trace_id`, exports ONE request's connected timeline instead:
+    a Perfetto trace object with per-actor process rows (handle →
+    router → ingress → engine) and flow events stitching the
+    cross-actor span ids (observability.perfetto)."""
+    runtime = get_runtime()
+    if trace_id is not None:
+        from ray_tpu.observability.perfetto import (
+            perfetto_trace,
+            write_perfetto_trace,
+        )
+
+        if filename:
+            return write_perfetto_trace(
+                filename, trace_id=trace_id, runtime=runtime
+            )
+        return perfetto_trace(trace_id=trace_id, runtime=runtime)
     from ray_tpu.util import tracing
 
-    runtime = get_runtime()
     events = runtime.task_events.chrome_trace() + tracing.chrome_spans(runtime)
     if filename:
         import json

@@ -22,7 +22,13 @@ Endpoints:
                              tracing spans (serving + training rows)
   GET /api/metrics_history[?limit=&since=]   gauge-suite timeseries ring
   GET /api/llm[?steps=]      LLM engine panel: stats, flight recorder,
-                             dead letters, per named engine actor
+                             dead letters, shed ring + overload counters,
+                             per named engine actor
+  GET /api/fleet[?steps=]    fleet observability: per-replica time ledger
+                             (host-schedule/device/commit/fabric/idle
+                             decomposition of step wall), goodput, MFU,
+                             merged cross-replica request histograms +
+                             percentiles (observability.fleet_snapshot)
   GET /api/serve             Serve control-plane panel: per-deployment
                              replica lifecycle states (STARTING/RUNNING/
                              DRAINING), transition history, drain durations,
@@ -63,6 +69,7 @@ _PAGE = """<!doctype html>
 <h2>Task summary</h2><table id="tasks"></table>
 <h2>Serve deployments</h2><div id="serve">none</div>
 <h2>LLM engines</h2><div id="llm">none</div>
+<h2>Fleet ledger</h2><div id="fleet">none</div>
 <h2>Train runs</h2><div id="train">none</div>
 <h2>History <span id="hist_legend" style="font-size:.75rem;font-weight:normal"></span></h2>
 <canvas id="hist" width="900" height="160"
@@ -108,6 +115,10 @@ function renderLLM(engines){
       `hit rate ${(m.prefix_cache_hit_rate??0).toFixed(2)} · `+
       `queue ${m.queue_depth} · preempt ${m.num_preemptions} · `+
       `dead letters ${m.num_dead_letters}`+
+      ((m.shed_requests||m.expired_requests||m.fabric_timeouts)?
+        ` · <span class=bad>shed ${m.shed_requests??0}</span>`+
+        ` · expired ${m.expired_requests??0}`+
+        (m.fabric_timeouts?` · fabric timeouts ${m.fabric_timeouts}`:''):'')+
       (m.async_scheduling?` · <b>async</b> host gap `+
         `${m.host_gap_mean_s==null?'—':(1e6*m.host_gap_mean_s).toFixed(0)+'µs'} mean`+
         ((e.latency_percentiles?.host_gap_s?.p50)!=null?
@@ -132,10 +143,44 @@ function renderLLM(engines){
       `${esc(c.program)}[${c.bucket}] ${c.compile_s.toFixed(2)}s`).join(' · ');
     const fails=(fr.failures||[]).slice(-5).map(f=>
       `<li class=bad>step ${f.step} ${esc(f.action)}: ${esc(f.error)}</li>`).join('');
+    const sheds=(e.shed_requests||[]).slice(-5).map(s=>
+      `${esc(s.request_id??'?')} ${esc(s.reason??'')} (queue ${s.queue_len??0}, `+
+      `retry ${((s.retry_after_s??0)*1e3).toFixed(0)}ms)`).join(' · ');
     return head+stepTable+
       (compiles?`<p style="font-size:.8rem">warmup compiles: ${compiles}</p>`:'')+
+      (sheds?`<p style="font-size:.8rem" class=bad>recent sheds: ${sheds}</p>`:'')+
       (fails?`<ul style="font-size:.8rem">${fails}</ul>`:'');
   }).join('<hr>');
+}
+function renderFleet(f){
+  const el=document.getElementById('fleet');
+  const reps=Object.entries(f.replicas||{});
+  if(!reps.length){el.textContent='none';return}
+  const cols=['idle_s','prefill_s','fabric_wait_s','host_schedule_s',
+              'device_s','commit_s','other_s','loop_s'];
+  const pct=x=>x==null?'—':(100*x).toFixed(1)+'%';
+  const rows=reps.map(([name,r])=>{
+    if(r.error)return `<tr><td class=mono>${esc(name)}</td>`+
+      `<td colspan=${cols.length+4} class=bad>${esc(r.error)}</td></tr>`;
+    const L=r.ledger;
+    return `<tr><td class=mono>${esc(name)}</td>`+
+      `<td>${L.wall_s.toFixed(2)}s</td>`+
+      cols.map(c=>`<td>${pct((L.fractions||{})[c])}</td>`).join('')+
+      `<td>${pct(L.coverage)}</td>`+
+      `<td>${L.goodput_tokens_per_s.toFixed(1)}</td>`+
+      `<td>${L.mfu==null?'—':pct(L.mfu)}</td></tr>`;
+  }).join('');
+  const fl=f.fleet||{};
+  const p=f.percentiles||{};
+  const pc=(m,q)=>p[m]?.[q]==null?'—':(1e3*p[m][q]).toFixed(1)+'ms';
+  el.innerHTML=`<table><tr><th>replica</th><th>wall</th>`+
+    cols.map(c=>`<th>${esc(c.replace(/_s$/,''))}</th>`).join('')+
+    `<th>Σ/wall</th><th>tok/s</th><th>MFU</th></tr>${rows}</table>`+
+    `<p style="font-size:.8rem">fleet: ${fl.replicas??0} replicas · `+
+    `${(fl.goodput_tokens_per_s??0).toFixed(1)} tok/s · `+
+    `top columns ${(fl.bottlenecks||[]).slice(0,3).map(esc).join(' → ')||'—'} · `+
+    `ttft p50/p99 ${pc('llm_request_ttft_seconds','p50')}/${pc('llm_request_ttft_seconds','p99')} · `+
+    `e2e p99 ${pc('llm_request_e2e_seconds','p99')}</p>`;
 }
 function renderServe(apps){
   const el=document.getElementById('serve');
@@ -217,6 +262,7 @@ async function refresh(){
     fill('tasks', Object.entries(s).map(([k,v])=>({task:k,count:v})));
     renderServe(await j('/api/serve'));
     renderLLM(await j('/api/llm?steps=12'));
+    renderFleet(await j('/api/fleet'));
     renderTrain(await j('/api/train?rounds=8'));
     const logs=await j('/api/logs?limit=200');
     document.getElementById('logs').textContent=
@@ -447,6 +493,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(
                 _llm_engines_snapshot(
                     runtime, steps_limit=int(q.get("steps", 32))
+                )
+            )
+        elif path == "/api/fleet":
+            from ray_tpu.observability import fleet_snapshot
+
+            self._json(
+                fleet_snapshot(
+                    runtime, steps_limit=int(q.get("steps", 512))
                 )
             )
         elif path == "/api/serve":

@@ -475,6 +475,15 @@ class LLMEngine:
         self._step_gap: Optional[float] = None
         self._step_dispatch_wall: Optional[float] = None
         self._step_commits: List[dict] = []
+        # Time-ledger stamps (instrument-gated, like the record they ride
+        # in): wall time the decode/verify results became host-readable,
+        # and measured seconds this step spent in prefill programs and in
+        # fabric restore RPCs — the fleet ledger decomposes duration_s
+        # into host-schedule / device / commit / prefill / fabric-wait
+        # from exactly these fields (ray_tpu.observability.ledger).
+        self._step_ready_wall: Optional[float] = None
+        self._step_prefill_s = 0.0
+        self._step_fabric_wait_s = 0.0
         self._host_gap_total = 0.0
         self._host_gap_count = 0
         self._host_gap_last: Optional[float] = None
@@ -809,6 +818,9 @@ class LLMEngine:
         self._step_gap = None
         self._step_dispatch_wall = None
         self._step_commits = []
+        self._step_ready_wall = None
+        self._step_prefill_s = 0.0
+        self._step_fabric_wait_s = 0.0
 
         # Deadline sweep BEFORE admission: a queued request whose deadline
         # passed must never reach schedule_prefills (resource-true expiry).
@@ -941,6 +953,13 @@ class LLMEngine:
                 "dispatch_time": self._step_dispatch_wall,
                 "commits": self._step_commits,
                 "host_gap_s": self._step_gap,
+                # Ledger inputs: wall time the decode/verify results were
+                # host-readable, measured prefill-plan seconds, measured
+                # fabric-restore seconds (observability.ledger decomposes
+                # duration_s into its time columns from these).
+                "ready_time": self._step_ready_wall,
+                "prefill_s": round(self._step_prefill_s, 6),
+                "fabric_wait_s": round(self._step_fabric_wait_s, 6),
             }
             if spec_info is not None:
                 # Verify record: which proposer ran, how wide the fed
@@ -992,6 +1011,7 @@ class LLMEngine:
         bs = self.engine_config.block_size
         restored = 0
         hit_blocks = 0
+        t_fabric = time.perf_counter() if self._instrument else 0.0
         for seq in admitted:
             plan = seq.pending_restore
             if not plan:
@@ -1019,6 +1039,10 @@ class LLMEngine:
         if restored:
             self._fabric_restored_total += restored
             self._fabric_restores.inc(restored, tags=self._metric_tags)
+        if self._instrument:
+            # Wall this step spent blocked on fabric store RPCs + block
+            # copy-ins: the ledger's fabric-wait column.
+            self._step_fabric_wait_s = time.perf_counter() - t_fabric
         return restored
 
     def _spill_block(self, block: int, block_hash: int) -> None:
@@ -1077,6 +1101,8 @@ class LLMEngine:
         # decode() returned == the program ran and its tokens are on
         # host: everything until the next dispatch is host-side gap.
         self._last_ready_t = time.perf_counter()
+        if instrument:
+            self._step_ready_wall = time.time()
         for i, seq in enumerate(decoding):
             # Per-sequence section; placed before any mutation so a
             # failure here leaves this sequence (and every later one,
@@ -1100,6 +1126,11 @@ class LLMEngine:
                 "dispatch_step": self._steps,
                 "time": time.time(),
                 "tokens": len(decoding),
+                # Measured commit seconds (results host-readable -> all
+                # emissions done): the ledger's commit column.
+                "commit_s": round(
+                    time.perf_counter() - self._last_ready_t, 6
+                ),
             }
         )
         if instrument:
@@ -1176,6 +1207,8 @@ class LLMEngine:
             tokens, block_tables, context_lens, true_lens
         )
         self._last_ready_t = time.perf_counter()
+        if instrument:
+            self._step_ready_wall = time.time()
         proposed = accepted = emitted = 0
         for i, (seq, props) in enumerate(zip(decoding, plans)):
             # Per-sequence commit section; nothing mutates before the
@@ -1215,6 +1248,9 @@ class LLMEngine:
                 "dispatch_step": self._steps,
                 "time": time.time(),
                 "tokens": emitted,
+                "commit_s": round(
+                    time.perf_counter() - self._last_ready_t, 6
+                ),
             }
         )
         self._verify_steps += 1
@@ -1303,6 +1339,9 @@ class LLMEngine:
         self._step_gap = None
         self._step_dispatch_wall = None
         self._step_commits = []
+        self._step_ready_wall = None
+        self._step_prefill_s = 0.0
+        self._step_fabric_wait_s = 0.0
 
         # Deadline sweep before the chain attempt: an expiry changes the
         # batch composition, so _try_chain refuses and the pipeline
@@ -1459,6 +1498,9 @@ class LLMEngine:
                 "dispatch_time": self._step_dispatch_wall,
                 "commits": self._step_commits,
                 "host_gap_s": self._step_gap,
+                "ready_time": self._step_ready_wall,
+                "prefill_s": round(self._step_prefill_s, 6),
+                "fabric_wait_s": round(self._step_fabric_wait_s, 6),
                 "chained": chained_seqs is not None,
                 "inflight_depth": len(self._inflight),
             }
@@ -1586,6 +1628,8 @@ class LLMEngine:
             # surfaces here, one step after dispatch, attributed above.
             rec.tokens_host = np.asarray(rec.tokens_dev)
             self._last_ready_t = time.perf_counter()
+            if instrument:
+                self._step_ready_wall = time.time()
         next_tokens = rec.tokens_host
         committed = 0
         while rec.commit_idx < len(rec.seqs):
@@ -1618,6 +1662,11 @@ class LLMEngine:
                 "dispatch_step": rec.dispatch_step,
                 "time": time.time(),
                 "tokens": committed,
+                "commit_s": (
+                    round(time.perf_counter() - t0, 6)
+                    if instrument
+                    else None
+                ),
             }
         )
         if instrument:
@@ -1645,6 +1694,7 @@ class LLMEngine:
         flight recorder."""
         instrument = self._instrument
         hit_tokens = 0
+        t_plan = time.perf_counter() if (instrument and plans) else 0.0
         for seq, take in plans:
             # Per-sequence section: an exception below is attributable to
             # this request (LLMServer._loop fails only it and keeps going).
@@ -1781,6 +1831,10 @@ class LLMEngine:
                 self._emit(seq)
                 self._maybe_finish(seq)
         self._current_rid = None
+        if instrument and plans:
+            # Whole-plan prefill seconds (programs + publication +
+            # emission): the ledger's prefill column for this step.
+            self._step_prefill_s = time.perf_counter() - t_plan
         return hit_tokens
 
     def _emit(self, seq: Sequence) -> None:
@@ -1894,6 +1948,10 @@ class LLMEngine:
             # PartitionSpec of the live pools (None at tp=1): proof the
             # cache is still head-sharded after whatever traffic ran.
             "kv_pool_sharding": self.runner.pool_sharding_spec(),
+            # Weight count for the fleet ledger's MFU estimate (decode
+            # FLOPs ~= 2 * model_params per generated token). Counted
+            # once at runner init, not per scrape.
+            "model_params": getattr(self.runner, "num_params", None),
             "host_transfer_bytes": self._host_transfer_bytes(),
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
@@ -2537,16 +2595,36 @@ class LLMServer:
         engine per refresh would triple the scrape's exposure to a busy
         engine's lock)."""
         with self._lock:
-            stats = self._engine.stats()
+            e = self._engine
+            stats = e.stats()
             stats["wedged"] = self._wedged
             stats["consecutive_step_failures"] = self._consecutive_step_failures
             return {
                 "metrics": stats,
-                "dead_letters": self._engine.dead_letters(),
-                "shed_requests": self._engine.shed_requests(),
-                "flight_record": self._engine.flight_recorder.snapshot(
-                    steps_limit
-                ),
+                "dead_letters": e.dead_letters(),
+                "shed_requests": e.shed_requests(),
+                "flight_record": e.flight_recorder.snapshot(steps_limit),
+                # Engine-side histogram snapshots for cross-replica
+                # aggregation (util.metrics.merge_snapshots): snapshotted
+                # here so the numbers are correct even when the engine
+                # actor runs out-of-process from the collector.
+                "histograms": {
+                    "llm_request_ttft_seconds": e._h_ttft.snapshot(
+                        e._metric_tags
+                    ),
+                    "llm_request_time_per_output_token_seconds": (
+                        e._h_tpot.snapshot(e._metric_tags)
+                    ),
+                    "llm_request_queue_time_seconds": e._h_queue.snapshot(
+                        e._metric_tags
+                    ),
+                    "llm_request_e2e_seconds": e._h_e2e.snapshot(
+                        e._metric_tags
+                    ),
+                    "llm_engine_step_host_gap_seconds": (
+                        e._h_host_gap.snapshot(e._metric_tags)
+                    ),
+                },
             }
 
     def reset_prefix_cache(self) -> None:

@@ -463,6 +463,12 @@ class GPTRunner:
 
             params = llm_shard_params(self.mesh, params)
         self.params = params
+        # Parameter count, once at init (a tree reduce over the weights is
+        # too slow for a stats() scrape): feeds the fleet ledger's MFU
+        # estimate — decode FLOPs ~= 2 * num_params per generated token.
+        self.num_params = int(
+            sum(x.size for x in jax.tree_util.tree_leaves(params))
+        )
         # Host-transfer accounting: bytes explicitly moved across the
         # host/device boundary by the program dispatches below (token ids,
         # block tables, lengths in; sampled token ids out). The pools and

@@ -437,13 +437,36 @@ def run_collapse_cell(
             process="ramp", rate=rate, ramp_to_rate=peak_rate, seed=seed
         )
         offsets = arrival_times(arrivals, len(requests))
-        result = run_open_loop(
-            handle,
-            requests,
-            offsets,
-            timeout_s=timeout_s,
-            settle_timeout_s=max(timeout_s * 2, 60.0),
-        )
+        # Live burn-rate monitoring over the overload burst: a
+        # discriminating spec pair sampled DURING the run (engines are
+        # thread-isolated by default, so the request histograms land in
+        # this process's registry). The impossible spec must burn >1.0
+        # while the ramp runs and the loose spec must not — the same
+        # exercise-the-gate-machinery contract as the SLO verdict pair.
+        from ray_tpu.observability import SLOBurnRateMonitor
+
+        burn_monitors = {
+            s.name: SLOBurnRateMonitor(s, windows=(2.0, 10.0)).start(
+                interval_s=0.25
+            )
+            for s in (LOOSE_SLO, IMPOSSIBLE_SLO)
+        }
+        try:
+            result = run_open_loop(
+                handle,
+                requests,
+                offsets,
+                timeout_s=timeout_s,
+                settle_timeout_s=max(timeout_s * 2, 60.0),
+            )
+        finally:
+            burn_peaks = {}
+            for mon_name, mon in burn_monitors.items():
+                try:
+                    mon.sample()  # final window before stopping
+                finally:
+                    mon.stop()
+                burn_peaks[mon_name] = mon.peak_burn()
         stats = _drain_engine(handle)
 
         rep = report_mod.build_report(result)
@@ -469,6 +492,10 @@ def run_collapse_cell(
             "arrival": arrivals.to_dict(),
             "report": rep,
             "slo": verdicts,
+            # Peak multi-window burn per monitored spec (sampled live
+            # during the ramp — the alerting-signal analog of the
+            # post-hoc SLO verdicts above).
+            "burn_rates": burn_peaks,
             "engine": {
                 "wedged": stats.get("wedged"),
                 "dead_letters": stats.get("num_dead_letters"),
@@ -523,6 +550,18 @@ def _gate_collapse(cell: dict) -> List[str]:
         )
     if cell["slo"]["impossible"]["passed"]:
         problems.append(f"{tag}: impossible SLO passed")
+    burns = cell.get("burn_rates") or {}
+    if not (burns.get("impossible", 0.0) > 1.0):
+        problems.append(
+            f"{tag}: impossible-SLO burn rate never exceeded 1.0 "
+            f"({burns.get('impossible')}) — the live monitor missed an "
+            "overload it cannot miss"
+        )
+    if not (burns.get("loose", float("inf")) < 1.0):
+        problems.append(
+            f"{tag}: loose-SLO burn rate hit {burns.get('loose')} — the "
+            "monitor alerted on a spec this run cannot violate"
+        )
     shed_p99 = rep["shed_latency_s"].get("p99")
     ttft_p50 = rep["percentiles"]["ttft_s"].get("p50")
     if shed_p99 is None or ttft_p50 is None or shed_p99 >= ttft_p50:
@@ -983,7 +1022,10 @@ def run_sweep(
         f"completed {crep['completed']}, "
         f"shed {crep['num_shed']}, failures {crep['num_failures']}, "
         f"shed p99 "
-        f"{(crep['shed_latency_s'].get('p99') or 0):.4f}s"
+        f"{(crep['shed_latency_s'].get('p99') or 0):.4f}s, "
+        f"burn loose/impossible "
+        f"{(collapse_cell['burn_rates'].get('loose') or 0):.2f}/"
+        f"{(collapse_cell['burn_rates'].get('impossible') or 0):.1f}"
         + (f"  !! {collapse_problems}" if collapse_problems else "")
     )
     # The KV-fabric locality pair: multiturn over 2 per-replica engines

@@ -1,0 +1,33 @@
+"""Fleet observability plane — cross-replica time ledger, Perfetto request
+timelines, and SLO burn-rate monitoring.
+
+The per-engine planes (metrics registry, flight recorder, trace buffer)
+are process-local; this package is the layer above them, in the spirit of
+the reference's cluster-wide dashboard/metrics plane: the ledger
+attributes fleet wall-clock to host-schedule / device / commit /
+fabric-wait / host-gap per replica (the MFU-style accounting that
+actually ranks TPU bottlenecks), the collector diff-merges histogram
+snapshots and flight rings into one fleet view, the SLO monitor turns
+live request histograms into multi-window burn rates, and the Perfetto
+exporter stitches one sampled request's cross-actor spans into a single
+loadable timeline.
+"""
+
+from ray_tpu.observability.collector import (  # noqa: F401
+    FleetCollector,
+    fleet_snapshot,
+)
+from ray_tpu.observability.ledger import (  # noqa: F401
+    LEDGER_COLUMNS,
+    fleet_ledger,
+    mfu_estimate,
+    replica_ledger,
+    step_ledger,
+)
+from ray_tpu.observability.perfetto import (  # noqa: F401
+    perfetto_trace,
+    write_perfetto_trace,
+)
+from ray_tpu.observability.slo_monitor import (  # noqa: F401
+    SLOBurnRateMonitor,
+)

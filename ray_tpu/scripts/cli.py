@@ -93,12 +93,103 @@ def cmd_train_stats(args) -> int:
     return 0
 
 
+_LEDGER_COLS = (
+    "idle_s", "prefill_s", "fabric_wait_s", "host_schedule_s",
+    "device_s", "commit_s", "other_s", "loop_s",
+)
+
+
+def _print_fleet(snap: dict) -> None:
+    replicas = snap.get("replicas") or {}
+    if not replicas:
+        print("no live llm engines")
+        return
+    short = [c[:-2] for c in _LEDGER_COLS]  # strip the _s suffix
+    header = (
+        f"{'replica':<28} {'wall':>8} "
+        + " ".join(f"{c:>9}" for c in short)
+        + f" {'sum/wall':>8} {'tok/s':>8} {'mfu':>6}"
+    )
+    print(header)
+    for name, row in sorted(replicas.items()):
+        if "error" in row:
+            print(f"{name:<28} error: {row['error']}")
+            continue
+        ledger = row["ledger"]
+        fr = ledger.get("fractions") or {}
+        pct = lambda x: f"{100 * x:8.1f}%" if x is not None else "       —"
+        cells = " ".join(pct(fr.get(c)) for c in _LEDGER_COLS)
+        cov = ledger.get("coverage")
+        mfu = ledger.get("mfu")
+        print(
+            f"{name:<28} {ledger['wall_s']:7.2f}s {cells}"
+            f" {pct(cov)} {ledger['goodput_tokens_per_s']:8.1f}"
+            f" {('%5.1f%%' % (100 * mfu)) if mfu is not None else '    —'}"
+        )
+    fleet = snap.get("fleet") or {}
+    tops = ", ".join((fleet.get("bottlenecks") or [])[:3]) or "—"
+    print(
+        f"fleet: {fleet.get('replicas', 0)} replicas · "
+        f"{fleet.get('goodput_tokens_per_s', 0.0):.1f} tok/s · "
+        f"top columns: {tops}"
+    )
+    for metric, p in (snap.get("percentiles") or {}).items():
+        p50 = p.get("p50")
+        p99 = p.get("p99")
+        fmt = lambda v: f"{1e3 * v:.1f}ms" if v is not None else "—"
+        print(f"  {metric}: p50 {fmt(p50)} p99 {fmt(p99)} (n={p['count']})")
+
+
+def cmd_top(args) -> int:
+    """Fleet time ledger: where every replica's wall time went
+    (host-schedule / device / commit / fabric-wait / idle / loop), with
+    goodput and MFU. With --url, polls a running head's dashboard
+    /api/fleet; without, scrapes this process's runtime directly (useful
+    from scripts that just served in-process)."""
+    import time as _time
+
+    def _fetch() -> dict:
+        if args.url:
+            import urllib.request
+
+            url = args.url.rstrip("/") + f"/api/fleet?steps={args.steps}"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+        from ray_tpu.observability import fleet_snapshot
+
+        return fleet_snapshot(steps_limit=args.steps)
+
+    if not args.url:
+        _init(args)
+    try:
+        while True:
+            snap = _fetch()
+            if args.json:
+                print(json.dumps(snap, indent=2, default=str))
+            else:
+                _print_fleet(snap)
+            if not args.watch:
+                break
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_timeline(args) -> int:
     import ray_tpu
 
     _init(args)
-    events = ray_tpu.timeline(args.output)
-    print(f"Wrote {len(events)} trace events to {args.output}")
+    trace_id = getattr(args, "trace_id", None)
+    out = ray_tpu.timeline(args.output, trace_id=trace_id)
+    if trace_id is not None:
+        n = len(out.get("traceEvents", []))
+        print(
+            f"Wrote {n} trace events for trace {trace_id} to "
+            f"{args.output} (load at https://ui.perfetto.dev)"
+        )
+    else:
+        print(f"Wrote {len(out)} trace events to {args.output}")
     return 0
 
 
@@ -302,6 +393,25 @@ def main(argv: Optional[list] = None) -> int:
 
     p_tl = sub.add_parser("timeline", help="export chrome trace")
     p_tl.add_argument("--output", default="timeline.json")
+    p_tl.add_argument(
+        "--trace-id",
+        default=None,
+        help="export ONE request's connected Perfetto timeline "
+        "(per-actor rows + flow events) instead of the cluster trace",
+    )
+
+    p_top = sub.add_parser(
+        "top", help="fleet time ledger: wall-time breakdown per replica"
+    )
+    p_top.add_argument(
+        "--url", default=None, help="dashboard base URL of a running head"
+    )
+    p_top.add_argument("--steps", type=int, default=512)
+    p_top.add_argument("--json", action="store_true")
+    p_top.add_argument(
+        "--watch", action="store_true", help="refresh continuously"
+    )
+    p_top.add_argument("--interval", type=float, default=2.0)
 
     p_job = sub.add_parser("job", help="job submission")
     job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
@@ -367,6 +477,7 @@ def main(argv: Optional[list] = None) -> int:
         "summary": cmd_summary,
         "train-stats": cmd_train_stats,
         "timeline": cmd_timeline,
+        "top": cmd_top,
         "job": cmd_job,
         "metrics": cmd_metrics,
         "lint": cmd_lint,

@@ -81,6 +81,10 @@ class LLMAutoscalingPolicy:
     target_ttft_p99_s: Optional[float] = None
     # Scale up when backlog / current_replicas exceeds this (None = unused).
     max_prefill_backlog_per_replica: Optional[float] = None
+    # SLO burn-rate ceiling (observability.SLOBurnRateMonitor feeds
+    # signals["slo_burn_rate"]): hot above it — the fleet is consuming
+    # error budget faster than the SLO allows (1.0 = exactly at budget).
+    target_burn_rate: Optional[float] = None
     look_back_period_s: float = 2.0
     downscale_margin: float = 0.5
     upscale_cooldown_s: float = 0.5
@@ -99,12 +103,15 @@ class LLMAutoscalingPolicy:
             self.target_queue_time_p99_s is None
             and self.target_ttft_p99_s is None
             and self.max_prefill_backlog_per_replica is None
+            and self.target_burn_rate is None
         ):
             raise ValueError(
                 "LLMAutoscalingPolicy needs at least one target: "
-                "target_queue_time_p99_s, target_ttft_p99_s, or "
-                "max_prefill_backlog_per_replica"
+                "target_queue_time_p99_s, target_ttft_p99_s, "
+                "max_prefill_backlog_per_replica, or target_burn_rate"
             )
+        if self.target_burn_rate is not None and self.target_burn_rate <= 0:
+            raise ValueError("target_burn_rate must be > 0")
         if not 0.0 < self.downscale_margin <= 1.0:
             raise ValueError("downscale_margin must be in (0, 1]")
 
@@ -112,7 +119,9 @@ class LLMAutoscalingPolicy:
         """Decide the target count from windowed SLO signals:
         {"queue_time_p99_s": float|None, "ttft_p99_s": float|None,
         "prefill_backlog_tokens": float, "window_complete": bool,
-        "decode_saturated": bool}. A None percentile means the window saw
+        "decode_saturated": bool, "slo_burn_rate": float|None (the
+        SLOBurnRateMonitor's shortest-window burn, when one feeds this
+        deployment)}. A None percentile means the window saw
         no samples for that signal — hot never fires on silence, cold
         treats silence as idle; backlog > 0 or decode saturation (every
         decode slot busy — histograms only sample at admission, so a
@@ -131,6 +140,12 @@ class LLMAutoscalingPolicy:
             if observed > target:
                 hot = True
             if observed >= self.downscale_margin * target:
+                cold = False
+        burn = signals.get("slo_burn_rate")
+        if self.target_burn_rate is not None and burn is not None:
+            if burn > self.target_burn_rate:
+                hot = True
+            if burn >= self.downscale_margin * self.target_burn_rate:
                 cold = False
         backlog = float(signals.get("prefill_backlog_tokens", 0.0) or 0.0)
         if (

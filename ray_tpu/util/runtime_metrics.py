@@ -272,6 +272,32 @@ def sample_llm_engine_metrics(runtime, timeout_s: float = 2.0) -> None:
             "Cumulative fabric-restored tokens / prefill tokens",
             tag_keys=("engine",),
         ),
+        # Overload-plane counters re-exported as scrape-time gauges: the
+        # engine's own llm_engine_shed_requests / expired_requests /
+        # fabric_timeouts Counters live in the engine's process, so a
+        # process-isolated engine's totals would otherwise never reach
+        # this head's /metrics exposition (distinct names — a Gauge may
+        # not shadow a Counter already registered in-process).
+        "shed_requests": get_or_create(
+            Gauge,
+            "llm_engine_overload_sheds",
+            "Cumulative submissions rejected by bounded admission or dead "
+            "on arrival (engine stats total)",
+            tag_keys=("engine",),
+        ),
+        "expired_requests": get_or_create(
+            Gauge,
+            "llm_engine_deadline_expiries",
+            "Cumulative in-flight requests expired past their deadline "
+            "(engine stats total)",
+            tag_keys=("engine",),
+        ),
+        "fabric_timeouts": get_or_create(
+            Gauge,
+            "llm_engine_fabric_timeouts_total",
+            "Cumulative KV-fabric restore timeouts (engine stats total)",
+            tag_keys=("engine",),
+        ),
     }
     fabric_bytes = get_or_create(
         Gauge,

@@ -249,11 +249,31 @@ def chrome_spans(runtime=None) -> List[dict]:
     trace so serving (`llm.*`) and training (`train.*`) spans land on the
     same timeline as the task events (`ray_tpu.timeline()` merges both).
     Task-kind spans are excluded — the task-event buffer already renders
-    those rows; duplicating them would double every task."""
+    those rows; duplicating them would double every task.
+
+    Each trace's pid row carries a `process_name` metadata event naming it
+    after the trace's ROOT span (e.g. `llm.request`, `train.step`) so the
+    timeline reads as labeled request/step groups instead of bare trace-id
+    prefixes. For a single request's connected cross-actor view with flow
+    events, use `ray_tpu.timeline(filename, trace_id=...)`
+    (observability.perfetto)."""
     rows: List[dict] = []
+    # trace pid -> (root-most span name, earliest start) for labeling.
+    roots: dict = {}
     for s in traces(runtime=runtime):
         if s.get("kind") != "user" or s.get("end_s") is None:
             continue
+        pid = f"trace:{s['trace_id'][:8]}"
+        root = roots.get(pid)
+        if (
+            root is None
+            or (s.get("parent_span_id") is None and root[2] is not None)
+            or (
+                (s.get("parent_span_id") is None) == (root[2] is None)
+                and s["start_s"] < root[1]
+            )
+        ):
+            roots[pid] = (s["name"], s["start_s"], s.get("parent_span_id"))
         rows.append(
             {
                 "cat": "span",
@@ -269,6 +289,17 @@ def chrome_spans(runtime=None) -> List[dict]:
                     "trace_id": s["trace_id"],
                     **(s.get("attributes") or {}),
                 },
+            }
+        )
+    for pid, (name, _start, _parent) in roots.items():
+        rows.append(
+            {
+                "ph": "M",
+                "cat": "__metadata",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"{name} ({pid})"},
             }
         )
     return rows
